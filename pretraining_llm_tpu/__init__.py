@@ -1,0 +1,30 @@
+"""pretraining_llm_tpu — a TPU-native LLM pretraining framework.
+
+A from-scratch JAX/XLA/Pallas/pjit framework with the capabilities of the
+reference PyTorch stack (`Flink-ddd/pretraining-llm`): GPT-2 BPE data pipeline
+(uint16 memmap shards), decoder-only transformer pretraining with AdamW, data/
+FSDP/tensor/sequence parallelism over a `jax.sharding.Mesh`, Pallas flash
+attention, ring attention for long context, sharded checkpoints with exact
+resume, and KV-cached autoregressive generation.
+
+Design principles (TPU-first, not a port):
+  - One compiled SPMD train step (`pjit`): forward, backward, grad reduce,
+    optimizer update, and metrics all fuse into a single XLA program.
+  - Pure functional model: params are pytrees, blocks are stacked and scanned
+    (`jax.lax.scan`) so the program is O(1) in depth for XLA.
+  - Parallelism is expressed as `PartitionSpec`s over a named mesh
+    (data/fsdp/tensor/seq); XLA inserts the ICI/DCN collectives.
+  - bf16 compute on the MXU with fp32 master params; no loss scaling needed.
+"""
+
+__version__ = "0.1.0"
+
+from pretraining_llm_tpu.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    get_preset,
+    list_presets,
+)

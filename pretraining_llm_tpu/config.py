@@ -1,0 +1,470 @@
+"""Typed configuration for models, data, training, and the device mesh.
+
+Replaces the reference's flat constants dict (`/root/reference/config/config.py:29-47`)
+with validated dataclasses. The reference ships with five config keys that are
+consumed but never defined (SURVEY.md Appendix B) — this module fails fast at
+construction time instead: every field is typed, defaulted, and checked in
+``__post_init__``/``validate``.
+
+Presets cover the five BASELINE.json configs plus the reference's own default
+3.16B shape (``reference-3b``) for parity accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+_ACTIVATIONS = ("relu", "gelu", "swiglu")
+_NORMS = ("layernorm", "rmsnorm")
+_POS_EMBEDS = ("learned", "rope")
+_ATTN_IMPLS = ("naive", "flash", "ring")
+_REMAT_POLICIES = ("none", "full", "dots_saveable")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of a decoder-only transformer.
+
+    The pluggable knobs (``activation``, ``norm``, ``pos_embed``,
+    ``use_output_proj``, ``tie_embeddings``) span the reference's exact
+    architecture (SURVEY.md §2.5: pre-LN, learned-absolute positions, ReLU MLP,
+    no attention output projection, untied biased lm_head) and the standard
+    GPT-2 / Llama shapes required by BASELINE.json configs #1-#5.
+    """
+
+    vocab_size: int = 50304
+    context_length: int = 1024
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_head: Optional[int] = None  # defaults to d_model // n_heads
+    mlp_ratio: float = 4.0
+    activation: str = "gelu"  # relu | gelu | swiglu
+    norm: str = "layernorm"  # layernorm | rmsnorm
+    pos_embed: str = "learned"  # learned | rope
+    rope_theta: float = 10000.0
+    use_output_proj: bool = True  # reference has none (attention.py:95)
+    tie_embeddings: bool = True  # reference unties (transformer.py:37-38)
+    lm_head_bias: bool = False  # reference has bias on lm_head
+    qkv_bias: bool = False  # reference: biasless K/Q/V (attention.py:29-31)
+    mlp_bias: bool = True  # reference: biases in MLP (mlp.py:24-26)
+    norm_eps: float = 1e-5
+    # Numerics
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    # Attention implementation: naive einsum | pallas flash | ring (seq-parallel)
+    attention_impl: str = "naive"
+    # Flash-attention block sizes (tuned for TPU MXU/VMEM; 0 = auto)
+    flash_block_q: int = 0
+    flash_block_kv: int = 0
+    # Rematerialization policy applied to each scanned block
+    remat: str = "none"  # none | full | dots_saveable
+    # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
+    sequence_parallel: bool = False
+
+    def __post_init__(self) -> None:
+        if self.activation not in _ACTIVATIONS:
+            raise ValueError(f"activation must be one of {_ACTIVATIONS}, got {self.activation!r}")
+        if self.norm not in _NORMS:
+            raise ValueError(f"norm must be one of {_NORMS}, got {self.norm!r}")
+        if self.pos_embed not in _POS_EMBEDS:
+            raise ValueError(f"pos_embed must be one of {_POS_EMBEDS}, got {self.pos_embed!r}")
+        if self.attention_impl not in _ATTN_IMPLS:
+            raise ValueError(
+                f"attention_impl must be one of {_ATTN_IMPLS}, got {self.attention_impl!r}"
+            )
+        if self.remat not in _REMAT_POLICIES:
+            raise ValueError(f"remat must be one of {_REMAT_POLICIES}, got {self.remat!r}")
+        if self.d_model % self.n_heads != 0 and self.d_head is None:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by n_heads={self.n_heads}; set d_head"
+            )
+        if not self.use_output_proj and self.head_dim * self.n_heads != self.d_model:
+            raise ValueError("use_output_proj=False requires n_heads*d_head == d_model")
+        if self.tie_embeddings and self.lm_head_bias:
+            raise ValueError("tie_embeddings is incompatible with lm_head_bias")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_head if self.d_head is not None else self.d_model // self.n_heads
+
+    @property
+    def d_ff(self) -> int:
+        return int(self.mlp_ratio * self.d_model)
+
+    def num_params(self) -> int:
+        """Analytic parameter count (matches init_params exactly; tested)."""
+        d, h, dh, f, v, t = (
+            self.d_model,
+            self.n_heads,
+            self.head_dim,
+            self.d_ff,
+            self.vocab_size,
+            self.context_length,
+        )
+        n = v * d  # token embedding
+        if self.pos_embed == "learned":
+            n += t * d
+        per_block = 0
+        per_block += 2 * self._norm_params()  # ln1, ln2
+        per_block += 3 * d * h * dh  # wqkv
+        if self.qkv_bias:
+            per_block += 3 * h * dh
+        if self.use_output_proj:
+            per_block += h * dh * d + d  # wo + bias
+        if self.activation == "swiglu":
+            per_block += d * 2 * f + f * d
+            if self.mlp_bias:
+                per_block += 2 * f + d
+        else:
+            per_block += d * f + f * d
+            if self.mlp_bias:
+                per_block += f + d
+        n += self.n_layers * per_block
+        n += self._norm_params()  # final norm
+        if not self.tie_embeddings:
+            n += d * v
+            if self.lm_head_bias:
+                n += v
+        return n
+
+    def _norm_params(self) -> int:
+        return 2 * self.d_model if self.norm == "layernorm" else self.d_model
+
+    def flops_per_token(self) -> int:
+        """Forward+backward training FLOPs per token (6N + attention term).
+
+        Standard approximation used for MFU: 6 * num_params for matmul
+        parameters plus 12 * n_layers * d_model * context_length for the
+        attention score/value matmuls (the O(T^2) term).
+        """
+        return 6 * self.num_params() + 12 * self.n_layers * self.d_model * self.context_length
+
+
+# ---------------------------------------------------------------------------
+# Mesh
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh: (data, fsdp, tensor, seq) axes.
+
+    Replaces the reference's DDP process-group bootstrap
+    (`/root/reference/scripts/train_transformer.py:15-29`). One axis per
+    parallelism strategy; axes of size 1 cost nothing. ``data=-1`` absorbs all
+    remaining devices.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    axis_names: Tuple[str, ...] = ("data", "fsdp", "tensor", "seq")
+
+    def sizes(self, n_devices: int) -> Tuple[int, int, int, int]:
+        fixed = self.fsdp * self.tensor * self.seq
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*tensor*seq={fixed}"
+                )
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.fsdp}x{self.tensor}x{self.seq} != {n_devices} devices"
+            )
+        return (data, self.fsdp, self.tensor, self.seq)
+
+
+# ---------------------------------------------------------------------------
+# Data
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline config.
+
+    Token files are flat uint16 memmaps — the same on-disk format as the
+    reference's preprocessor output (`/root/reference/scripts/data_preprocess.py:47-62`)
+    so existing datasets drop in unchanged.
+    """
+
+    train_path: str = "data/train.bin"
+    val_path: str = "data/val.bin"
+    dataset_name: str = "openwebtext"
+    tokenizer_name: str = "gpt2"
+    val_fraction: float = 0.0005
+    split_seed: int = 42
+    sample_seed: int = 1337  # reference uses unseeded torch.randint (Q1) — we seed
+    prefetch: int = 2  # double-buffered device_put prefetch depth
+    use_native_batcher: bool = True  # C++ batch gather when the extension is built
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+_LR_SCHEDULES = ("warmup_constant", "warmup_cosine")
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 32  # global batch (sequences per optimizer step)
+    microbatches: int = 1  # gradient accumulation via lax.scan
+    train_steps: int = 200_000
+    eval_interval: int = 1000
+    eval_iters: int = 250
+    lr: float = 3e-4
+    lr_schedule: str = "warmup_cosine"  # reference: 10% warmup then constant
+    warmup_frac: float = 0.1
+    min_lr_frac: float = 0.1  # cosine floor as a fraction of lr
+    weight_decay: float = 0.1
+    adam_b1: float = 0.9
+    adam_b2: float = 0.95
+    adam_eps: float = 1e-8
+    grad_clip: float = 1.0  # 0 disables
+    seed: int = 0
+    checkpoint_dir: str = "checkpoints"
+    checkpoint_interval: int = 1000  # reference saves only once at the end
+    keep_checkpoints: int = 3
+    log_interval: int = 10
+    metrics_path: str = ""  # JSONL sink; "" = stdout only
+
+    def __post_init__(self) -> None:
+        if self.lr_schedule not in _LR_SCHEDULES:
+            raise ValueError(f"lr_schedule must be one of {_LR_SCHEDULES}")
+        if self.batch_size % self.microbatches != 0:
+            raise ValueError(
+                f"batch_size={self.batch_size} not divisible by microbatches={self.microbatches}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Top-level
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    name: str = "custom"
+
+    def replace(self, **sections: Any) -> "Config":
+        return dataclasses.replace(self, **sections)
+
+    def with_overrides(self, overrides: Dict[str, Any]) -> "Config":
+        """Apply dotted-path overrides, e.g. {"model.n_layers": 4}.
+
+        Unknown keys raise — the exact failure class the reference ships with
+        (SURVEY.md Appendix B) is rejected at startup.
+        """
+        sections: Dict[str, Dict[str, Any]] = {}
+        top: Dict[str, Any] = {}
+        for key, value in overrides.items():
+            if "." in key:
+                section, fname = key.split(".", 1)
+                if section not in ("model", "mesh", "data", "train"):
+                    raise KeyError(f"unknown config section {section!r} in override {key!r}")
+                sections.setdefault(section, {})[fname] = value
+            else:
+                if key != "name":
+                    raise KeyError(f"unknown top-level config key {key!r}")
+                top[key] = value
+        new = self
+        for section, kw in sections.items():
+            old = getattr(new, section)
+            valid = {f.name for f in dataclasses.fields(old)}
+            for k in kw:
+                if k not in valid:
+                    raise KeyError(f"unknown config key {section}.{k}")
+            new = dataclasses.replace(new, **{section: dataclasses.replace(old, **kw)})
+        if top:
+            new = dataclasses.replace(new, **top)
+        return new
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Config":
+        raw = json.loads(text)
+        return Config(
+            model=ModelConfig(**raw["model"]),
+            mesh=MeshConfig(**{k: tuple(v) if k == "axis_names" else v for k, v in raw["mesh"].items()}),
+            data=DataConfig(**raw["data"]),
+            train=TrainConfig(**raw["train"]),
+            name=raw.get("name", "custom"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Presets — the 5 BASELINE.json configs + reference parity shape
+# ---------------------------------------------------------------------------
+
+
+def _gpt2_model(**kw: Any) -> ModelConfig:
+    base = dict(
+        vocab_size=50304,
+        activation="gelu",
+        norm="layernorm",
+        pos_embed="learned",
+        use_output_proj=True,
+        tie_embeddings=True,
+        qkv_bias=True,
+        mlp_bias=True,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _llama_model(**kw: Any) -> ModelConfig:
+    base = dict(
+        activation="swiglu",
+        norm="rmsnorm",
+        pos_embed="rope",
+        use_output_proj=True,
+        tie_embeddings=False,
+        lm_head_bias=False,
+        qkv_bias=False,
+        mlp_bias=False,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+_PRESETS: Dict[str, Config] = {}
+
+
+def _register(name: str, cfg: Config) -> None:
+    _PRESETS[name] = dataclasses.replace(cfg, name=name)
+
+
+# BASELINE config #1: GPT-2 124M single-process (tiny-shakespeare, CPU ref)
+_register(
+    "gpt2-124m",
+    Config(
+        model=_gpt2_model(context_length=1024, d_model=768, n_heads=12, n_layers=12),
+        mesh=MeshConfig(),
+        train=TrainConfig(batch_size=12, train_steps=5000, lr=6e-4, eval_interval=250, eval_iters=20),
+    ),
+)
+
+# BASELINE config #2: GPT-2 350M data-parallel on v4-8 (psum grads only)
+_register(
+    "gpt2-350m-dp",
+    Config(
+        model=_gpt2_model(context_length=1024, d_model=1024, n_heads=16, n_layers=24),
+        mesh=MeshConfig(data=-1),
+        train=TrainConfig(batch_size=32, lr=3e-4),
+    ),
+)
+
+# BASELINE config #3: GPT-2 1.3B FSDP-style param/optimizer sharding on v4-32
+_register(
+    "gpt2-1p3b-fsdp",
+    Config(
+        model=_gpt2_model(
+            context_length=1024, d_model=2048, n_heads=16, n_layers=24, remat="dots_saveable"
+        ),
+        mesh=MeshConfig(data=-1, fsdp=8),
+        train=TrainConfig(batch_size=64, lr=2e-4, microbatches=2),
+    ),
+)
+
+# BASELINE config #4: Llama-style 1B (RoPE + SwiGLU + RMSNorm)
+_register(
+    "llama-1b",
+    Config(
+        model=_llama_model(
+            vocab_size=32000,
+            context_length=2048,
+            d_model=2048,
+            n_heads=16,
+            n_layers=22,
+            mlp_ratio=2.6875,  # d_ff = 5504, Llama-style 8/3 rounding
+            remat="dots_saveable",
+        ),
+        mesh=MeshConfig(data=-1, fsdp=4),
+        train=TrainConfig(batch_size=32, lr=3e-4, weight_decay=0.1),
+    ),
+)
+
+# BASELINE config #5: 8k-context pretraining, Pallas flash-attn + sequence parallel
+_register(
+    "gpt2-8k-sp",
+    Config(
+        model=_gpt2_model(
+            context_length=8192,
+            d_model=768,
+            n_heads=12,
+            n_layers=12,
+            pos_embed="rope",  # learned-absolute does not extrapolate; 8k uses RoPE
+            attention_impl="ring",
+            sequence_parallel=True,
+            remat="dots_saveable",
+        ),
+        mesh=MeshConfig(data=-1, seq=4),
+        train=TrainConfig(batch_size=8, lr=3e-4),
+    ),
+)
+
+# The reference's own default shape (config/config.py:4-8 + src/models/*):
+# 3.16B params — vocab 50304, ctx 512, d 2048, 16 heads, 64 blocks, ReLU MLP,
+# no attention output projection, untied biased lm_head, learned positions.
+_register(
+    "reference-3b",
+    Config(
+        model=ModelConfig(
+            vocab_size=50304,
+            context_length=512,
+            d_model=2048,
+            n_heads=16,
+            n_layers=64,
+            activation="relu",
+            norm="layernorm",
+            pos_embed="learned",
+            use_output_proj=False,
+            tie_embeddings=False,
+            lm_head_bias=True,
+            qkv_bias=False,
+            mlp_bias=True,
+            remat="dots_saveable",
+        ),
+        mesh=MeshConfig(data=-1, fsdp=4),
+        train=TrainConfig(batch_size=32, train_steps=200_000, lr=1e-4, eval_interval=1000, eval_iters=250),
+    ),
+)
+
+# Tiny config for tests and smoke runs.
+_register(
+    "tiny",
+    Config(
+        model=_gpt2_model(vocab_size=256, context_length=64, d_model=32, n_heads=4, n_layers=2),
+        mesh=MeshConfig(),
+        train=TrainConfig(batch_size=8, train_steps=50, eval_interval=20, eval_iters=2, lr=1e-3),
+    ),
+)
+
+
+def get_preset(name: str) -> Config:
+    if name not in _PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {sorted(_PRESETS)}")
+    return _PRESETS[name]
+
+
+def list_presets() -> Tuple[str, ...]:
+    return tuple(sorted(_PRESETS))

@@ -1,0 +1,5 @@
+from pretraining_llm_tpu.models.transformer import (  # noqa: F401
+    forward,
+    init_params,
+    loss_fn,
+)

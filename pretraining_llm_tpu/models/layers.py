@@ -1,0 +1,84 @@
+"""Layer primitives: norms, activations, RoPE — pure functions on pytrees.
+
+Capability superset of the reference's `src/models/{mlp,attention}.py` layer
+zoo, redesigned functional: no module state, explicit params, fp32 norm math
+with bf16 matmul inputs (TPU MXU native), and pluggable position encodings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+# ---------------------------------------------------------------------------
+# Normalization — computed in fp32, output cast back to the input dtype.
+# ---------------------------------------------------------------------------
+
+
+def layernorm(p: Params, x: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rmsnorm(p: Params, x: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    y = y * p["scale"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def apply_norm(kind: str, p: Params, x: jax.Array, eps: float) -> jax.Array:
+    return layernorm(p, x, eps) if kind == "layernorm" else rmsnorm(p, x, eps)
+
+
+def init_norm(kind: str, d: int, dtype: jnp.dtype) -> Params:
+    p = {"scale": jnp.ones((d,), dtype)}
+    if kind == "layernorm":
+        p["bias"] = jnp.zeros((d,), dtype)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+def activation_fn(kind: str, x: jax.Array) -> jax.Array:
+    if kind == "relu":
+        return jax.nn.relu(x)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"activation_fn does not handle {kind!r} (swiglu is fused in mlp)")
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings
+# ---------------------------------------------------------------------------
+
+
+def rope_table(context_length: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape (T, head_dim // 2), fp32."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = jnp.arange(context_length, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Rotate (B, T, H, Dh) by position. positions: (T,) int32 into the table."""
+    cos_t = cos[positions][None, :, None, :]  # (1, T, 1, Dh/2)
+    sin_t = sin[positions][None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos_t - x2 * sin_t, x2 * cos_t + x1 * sin_t], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,1 @@
+from pretraining_llm_tpu.ops.attention import multihead_attention, naive_attention  # noqa: F401

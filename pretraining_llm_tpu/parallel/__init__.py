@@ -1,0 +1,6 @@
+from pretraining_llm_tpu.parallel.mesh import build_mesh, initialize_distributed  # noqa: F401
+from pretraining_llm_tpu.parallel.sharding import (  # noqa: F401
+    batch_pspec,
+    named_sharding_tree,
+    param_pspec_tree,
+)

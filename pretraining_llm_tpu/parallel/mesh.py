@@ -1,0 +1,68 @@
+"""Device mesh construction and multi-host bootstrap.
+
+TPU-native replacement for the reference's DDP process-group setup
+(`/root/reference/scripts/train_transformer.py:15-29`, which reads
+RANK/LOCAL_RANK/WORLD_SIZE and calls `dist.init_process_group`). On TPU the
+runtime owns transport: one process per host calls
+`jax.distributed.initialize()`, and all parallelism is expressed as shardings
+over a named `jax.sharding.Mesh` whose axes ride ICI within a slice and DCN
+across slices. There is no NCCL analog to manage.
+
+Axes (sized by `MeshConfig`):
+  data   — pure data parallelism (gradient all-reduce)
+  fsdp   — data parallelism + param/optimizer-state sharding (ZeRO-3 style)
+  tensor — Megatron-style tensor parallelism (heads / mlp hidden / vocab)
+  seq    — sequence/context parallelism (ring attention, Megatron-SP)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from pretraining_llm_tpu.config import MeshConfig
+
+
+def initialize_distributed() -> None:
+    """Initialize the multi-host JAX runtime when running under a launcher.
+
+    MUST be called before anything touches a device (jax.distributed.initialize
+    refuses to run once the XLA backend exists) — entry points call it first.
+    A single-process run (no coordinator address in the environment) is a
+    no-op. Mirrors the reference's `if 'RANK' in os.environ` gate
+    (train_transformer.py:15) in spirit, keyed on JAX's own coordination env
+    vars.
+    """
+    if "JAX_COORDINATOR_ADDRESS" in os.environ or "COORDINATOR_ADDRESS" in os.environ:
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            pass  # already initialized (e.g. called twice)
+
+
+def build_mesh(
+    mesh_config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the named device mesh.
+
+    Devices are laid out so that the fastest-varying axes (tensor, seq) map to
+    physically adjacent devices — XLA's default device order enumerates ICI
+    neighbors contiguously, so putting the most communication-heavy axes last
+    keeps their collectives on the shortest ICI paths.
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = mesh_config.sizes(len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, mesh_config.axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1x1x1x1 mesh on the first device — for tests and CPU smoke runs."""
+    return Mesh(
+        np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), ("data", "fsdp", "tensor", "seq")
+    )

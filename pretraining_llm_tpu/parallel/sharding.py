@@ -1,0 +1,142 @@
+"""Partition rules: param pytree paths -> PartitionSpecs over the named mesh.
+
+This is the TPU-native replacement for everything the reference delegates to
+DDP (`/root/reference/scripts/train_transformer.py:123`): instead of wrapping
+the model in a replicating container, each parameter gets a `PartitionSpec`
+over the (data, fsdp, tensor, seq) mesh and XLA inserts the collectives.
+
+The rules implement:
+  - FSDP/ZeRO-3: every large matrix shards one dimension over 'fsdp'
+    (params AND optimizer moments — the spec tree is reused for both).
+  - Megatron TP: attention heads, MLP hidden dim and the vocab dim shard over
+    'tensor'; the pairing (column-parallel w1/wqkv, row-parallel w2/wo) means
+    XLA only needs one all-reduce per residual branch.
+  - Norm scales/biases are replicated (tiny).
+
+Because the train step is a single global-view `pjit` program, any spec is
+*correct* — the rules only decide layout/performance. Sharding-invariance is
+enforced by tests (same loss on a 1-device and an 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_names(path: Tuple[Any, ...]) -> Tuple[str, ...]:
+    names = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            names.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            names.append(str(entry.idx))
+        else:
+            names.append(str(entry))
+    return tuple(names)
+
+
+def param_pspec(path_names: Tuple[str, ...], ndim: int) -> P:
+    """PartitionSpec for one parameter, keyed on its pytree path.
+
+    Parameters under 'blocks' are stacked with a leading n_layers dim (scanned
+    by the model), which is never sharded — specs for those get a leading None.
+    """
+    name = path_names[-1]
+    parent = path_names[-2] if len(path_names) >= 2 else ""
+    in_blocks = "blocks" in path_names
+
+    def blk(*spec: Optional[str]) -> P:
+        return P(None, *spec) if in_blocks else P(*spec)
+
+    if name == "embedding":
+        if parent == "tok_embed":
+            return P("tensor", "fsdp")  # (V, D): vocab TP, dim FSDP
+        return P(None, "fsdp")  # (T, D) learned positions
+    if parent in ("ln1", "ln2", "final_norm") or name in ("scale",):
+        return blk(*([None] * (ndim - (1 if in_blocks else 0))))
+    if name == "wqkv":  # (D, 3, H, Dh): column-parallel over heads
+        return blk("fsdp", None, "tensor", None)
+    if name == "bqkv":  # (3, H, Dh)
+        return blk(None, "tensor", None)
+    if name == "wo":  # (H, Dh, D): row-parallel
+        return blk("tensor", None, "fsdp")
+    if name == "bo":  # (D,)
+        return blk(None)
+    if name == "w1":  # (D, F) or (D, 2, F) for swiglu: column-parallel
+        if ndim - (1 if in_blocks else 0) == 3:
+            return blk("fsdp", None, "tensor")
+        return blk("fsdp", "tensor")
+    if name == "b1":  # (F,) or (2, F)
+        if ndim - (1 if in_blocks else 0) == 2:
+            return blk(None, "tensor")
+        return blk("tensor")
+    if name == "w2":  # (F, D): row-parallel
+        return blk("tensor", "fsdp")
+    if name == "b2":  # (D,)
+        return blk(None)
+    if name == "kernel" and parent == "lm_head":  # (D, V)
+        return P("fsdp", "tensor")
+    if name == "bias" and parent == "lm_head":  # (V,)
+        return P("tensor")
+    if name == "bias":  # norm biases and any other small bias: replicate
+        return blk(*([None] * (ndim - (1 if in_blocks else 0))))
+    # Fallback: shard nothing rather than guess wrong.
+    return P(*([None] * ndim))
+
+
+def param_pspec_tree(params: Any) -> Any:
+    """Map a params (or optimizer-moment) pytree to a PartitionSpec pytree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: param_pspec(_path_names(path), getattr(leaf, "ndim", 0)), params
+    )
+
+
+def batch_pspec(sequence_parallel: bool = False) -> P:
+    """Spec for (B, T) token batches: batch over data+fsdp, seq over 'seq'."""
+    return P(("data", "fsdp"), "seq" if sequence_parallel else None)
+
+
+def named_sharding_tree(mesh: Mesh, pspec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Activation sharding constraints
+# ---------------------------------------------------------------------------
+# The model is mesh-agnostic; the trainer installs the active mesh here before
+# tracing so `constrain` can annotate activations. Outside a mesh context the
+# helper is a no-op, which keeps single-device paths (tests, generation)
+# mesh-free.
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+@contextlib.contextmanager
+def activation_mesh(mesh: Optional[Mesh]) -> Iterator[None]:
+    global _CURRENT_MESH
+    prev, _CURRENT_MESH = _CURRENT_MESH, mesh
+    try:
+        yield
+    finally:
+        _CURRENT_MESH = prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+def constrain(x: jax.Array, *spec: Any) -> jax.Array:
+    """Annotate an intermediate with a sharding over the active mesh (no-op
+    when no mesh is installed)."""
+    mesh = _CURRENT_MESH
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

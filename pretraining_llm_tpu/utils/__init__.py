@@ -1,0 +1,2 @@
+from pretraining_llm_tpu.utils.hardware import device_peak_flops  # noqa: F401
+from pretraining_llm_tpu.utils.pytree import tree_num_params, tree_cast  # noqa: F401

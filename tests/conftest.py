@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU backend.
+
+This is the fake-distributed-backend the reference lacks entirely (SURVEY §4):
+every mesh/pjit/psum/ring-attention test runs against 8 virtual CPU devices,
+so multi-chip semantics are exercised without TPU hardware.
+
+jax is pre-imported by the environment's sitecustomize with a TPU backend
+registered, but backends initialize lazily — flipping the platform config here
+(before any test touches a device) is sufficient.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+
+@pytest.fixture(scope="session")
+def mesh8() -> Mesh:
+    """2 data x 2 fsdp x 2 tensor x 1 seq mesh over the 8 virtual devices."""
+    devs = np.asarray(jax.devices()).reshape(2, 2, 2, 1)
+    return Mesh(devs, ("data", "fsdp", "tensor", "seq"))
+
+
+@pytest.fixture(scope="session")
+def mesh_seq4() -> Mesh:
+    """2 data x 1 x 1 x 4 seq mesh for ring-attention tests."""
+    devs = np.asarray(jax.devices()).reshape(2, 1, 1, 4)
+    return Mesh(devs, ("data", "fsdp", "tensor", "seq"))

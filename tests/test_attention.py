@@ -1,0 +1,91 @@
+"""Attention ops: naive vs blockwise/flash numerics, masking semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.ops.attention import multihead_attention, naive_attention
+from pretraining_llm_tpu.ops.flash_attention import blockwise_attention
+
+
+def _qkv(key, b=2, t=64, h=4, dh=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, t, h, dh), dtype) for k in ks)
+
+
+def test_naive_matches_explicit_softmax():
+    q, k, v = _qkv(jax.random.key(0))
+    out = naive_attention(q, k, v)
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    t = q.shape[1]
+    mask = np.tril(np.ones((t, t), bool))
+    scores = np.where(mask[None, None], scores, -np.inf)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block_q,block_kv", [(16, 16), (32, 8), (8, 32), (64, 64)])
+def test_blockwise_matches_naive(causal, block_q, block_kv):
+    q, k, v = _qkv(jax.random.key(1))
+    want = naive_attention(q, k, v, causal=causal)
+    got = blockwise_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_gradients_match_naive():
+    q, k, v = _qkv(jax.random.key(2), t=32)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v) ** 2)
+
+    def loss_block(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, block_q=8, block_kv=8) ** 2)
+
+    g1 = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_dispatch_via_multihead():
+    q, k, v = _qkv(jax.random.key(3))
+    want = multihead_attention(q, k, v, impl="naive")
+    got = multihead_attention(q, k, v, impl="flash", block_q=16, block_kv=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_kv_cache_masking_matches_full_context():
+    """Decode semantics: attending over a padded cache == attending the prefix."""
+    b, t, h, dh = 1, 16, 2, 8
+    q, k, v = _qkv(jax.random.key(4), b=b, t=t, h=h, dh=dh)
+    full = naive_attention(q, k, v)
+    # Simulate cache of capacity 32 holding only t valid entries.
+    pad = 32 - t
+    k_pad = jnp.concatenate([k, jnp.ones((b, pad, h, dh))], axis=1)
+    v_pad = jnp.concatenate([v, jnp.ones((b, pad, h, dh))], axis=1)
+    kv_mask = (jnp.arange(32) < t)[None, :]
+    cached = naive_attention(
+        q,
+        k_pad,
+        v_pad,
+        q_positions=jnp.arange(t),
+        kv_positions=jnp.arange(32),
+        kv_mask=kv_mask,
+    )
+    np.testing.assert_allclose(np.asarray(cached), np.asarray(full), rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_inputs_fp32_softmax():
+    q, k, v = _qkv(jax.random.key(5), dtype=jnp.bfloat16)
+    out = naive_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = naive_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )

@@ -1,0 +1,71 @@
+"""Config layer: presets, validation, overrides, analytic param counts."""
+
+import dataclasses
+
+import pytest
+
+from pretraining_llm_tpu.config import Config, MeshConfig, ModelConfig, get_preset, list_presets
+
+
+def test_presets_exist():
+    names = list_presets()
+    for required in (
+        "gpt2-124m",
+        "gpt2-350m-dp",
+        "gpt2-1p3b-fsdp",
+        "llama-1b",
+        "gpt2-8k-sp",
+        "reference-3b",
+        "tiny",
+    ):
+        assert required in names
+
+
+def test_reference_3b_param_count():
+    # SURVEY.md §2.5: the reference's default config is 3.161B params
+    # (103.0M tok-embed + 1.0M pos-embed + 64 x 46.16M blocks + 103.1M lm_head).
+    cfg = get_preset("reference-3b").model
+    n = cfg.num_params()
+    assert abs(n - 3.161e9) / 3.161e9 < 0.01, n
+
+
+def test_gpt2_124m_param_count():
+    cfg = get_preset("gpt2-124m").model
+    n = cfg.num_params()
+    assert abs(n - 124e6) / 124e6 < 0.05, n
+
+
+def test_unknown_override_rejected():
+    cfg = get_preset("tiny")
+    with pytest.raises(KeyError):
+        cfg.with_overrides({"model.not_a_key": 1})
+    with pytest.raises(KeyError):
+        cfg.with_overrides({"nonsection.x": 1})
+
+
+def test_override_applies():
+    cfg = get_preset("tiny").with_overrides({"model.n_layers": 3, "train.lr": 1e-5})
+    assert cfg.model.n_layers == 3
+    assert cfg.train.lr == 1e-5
+
+
+def test_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        ModelConfig(activation="tanh")
+    with pytest.raises(ValueError):
+        ModelConfig(d_model=30, n_heads=4)
+    with pytest.raises(ValueError):
+        ModelConfig(tie_embeddings=True, lm_head_bias=True)
+
+
+def test_mesh_sizes():
+    assert MeshConfig(data=-1, fsdp=2).sizes(8) == (4, 2, 1, 1)
+    assert MeshConfig(data=2, fsdp=2, tensor=2).sizes(8) == (2, 2, 2, 1)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).sizes(8)
+
+
+def test_json_roundtrip():
+    cfg = get_preset("llama-1b")
+    restored = Config.from_json(cfg.to_json())
+    assert restored == cfg
