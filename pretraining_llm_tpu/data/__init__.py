@@ -1,0 +1,1 @@
+from pretraining_llm_tpu.data.loader import get_batch_iterator, MemmapTokens  # noqa: F401

@@ -1,0 +1,2 @@
+from pretraining_llm_tpu.training.trainer import Trainer  # noqa: F401
+from pretraining_llm_tpu.training.train_step import build_train_step, init_train_state  # noqa: F401

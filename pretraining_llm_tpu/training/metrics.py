@@ -1,0 +1,68 @@
+"""Structured metrics: JSONL + stdout, throughput and MFU accounting.
+
+Replaces the reference's master-only `print()`s of step/loss/ms
+(`/root/reference/scripts/train_transformer.py:97-101`) with a structured
+stream (SURVEY §5): every record carries loss, grad-norm, LR, tokens/sec/chip,
+and MFU computed from the model's analytic FLOP count against the chip's peak.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional, TextIO
+
+import jax
+
+from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.utils.hardware import device_peak_flops
+
+
+class MetricsLogger:
+    def __init__(self, jsonl_path: str = "", stream: Optional[TextIO] = None) -> None:
+        self.stream = stream or sys.stdout
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+
+    def log(self, record: Dict[str, Any]) -> None:
+        record = {k: (float(v) if hasattr(v, "item") else v) for k, v in record.items()}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        parts = []
+        for key, val in record.items():
+            if isinstance(val, float):
+                parts.append(f"{key} {val:.4g}")
+            else:
+                parts.append(f"{key} {val}")
+        print(" | ".join(parts), file=self.stream, flush=True)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+
+
+class Throughput:
+    """Sliding throughput/MFU meter. Call `tick(tokens)` once per step."""
+
+    def __init__(self, model_cfg: ModelConfig, n_chips: Optional[int] = None) -> None:
+        self.flops_per_token = model_cfg.flops_per_token()
+        self.n_chips = n_chips or jax.device_count()
+        self.peak = device_peak_flops() * self.n_chips
+        self._last_time: Optional[float] = None
+
+    def tick(self, tokens: int) -> Dict[str, float]:
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            return {}
+        dt = now - self._last_time
+        self._last_time = now
+        tok_per_sec = tokens / dt
+        mfu = tok_per_sec * self.flops_per_token / self.peak
+        return {
+            "step_ms": dt * 1e3,
+            "tokens_per_sec": tok_per_sec,
+            "tokens_per_sec_chip": tok_per_sec / self.n_chips,
+            "mfu": mfu,
+        }

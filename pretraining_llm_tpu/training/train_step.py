@@ -1,0 +1,156 @@
+"""The single compiled SPMD train step.
+
+The reference's step is many separate device launches — autocast forward,
+scaled backward, DDP bucketed all-reduce, scaler step, zero_grad
+(`/root/reference/scripts/train_transformer.py:64-94`). Here the *entire*
+optimizer step is one `jit`-compiled XLA program over the global mesh:
+
+    grads = mean over microbatches (lax.scan)   # grad accumulation, done right
+    clip -> AdamW -> new params                  # fused into the same program
+    collectives inserted by XLA from shardings   # no NCCL calls to write
+
+Gradient accumulation via `lax.scan` fixes the reference's broken
+every-other-step sync gating (SURVEY §A B7) by construction: the optimizer
+sees exactly the mean gradient of the full global batch.
+
+State is a plain dict pytree {'params', 'opt', 'step'} so checkpointing and
+sharding rules treat it uniformly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pretraining_llm_tpu.config import Config
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.parallel.sharding import (
+    activation_mesh,
+    batch_pspec,
+    named_sharding_tree,
+    param_pspec_tree,
+)
+from pretraining_llm_tpu.training import optimizer as opt
+
+TrainState = Dict[str, Any]
+
+
+def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
+    params = transformer.init_params(cfg.model, key)
+    return {"params": params, "opt": opt.adamw_init(params), "step": jnp.zeros((), jnp.int32)}
+
+
+def state_pspec_tree(state: TrainState) -> Any:
+    """PartitionSpecs for the full train state (moments mirror params)."""
+    pspecs = param_pspec_tree(state["params"])
+    return {
+        "params": pspecs,
+        "opt": {
+            "mu": param_pspec_tree(state["opt"]["mu"]),
+            "nu": param_pspec_tree(state["opt"]["nu"]),
+            "count": P(),
+        },
+        "step": P(),
+    }
+
+
+def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
+    shardings = named_sharding_tree(mesh, state_pspec_tree(state))
+    return jax.device_put(state, shardings)
+
+
+def _loss_and_metrics(params, xb, yb, model_cfg):
+    loss = transformer.loss_fn(params, xb, yb, model_cfg)
+    return loss
+
+
+def build_train_step(
+    cfg: Config, mesh: Optional[Mesh] = None
+) -> Callable[[TrainState, Tuple[jax.Array, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Compile the train step. batch: (x, y) each (B, T) int32, B = global batch."""
+    model_cfg = cfg.model
+    tcfg = cfg.train
+    n_micro = tcfg.microbatches
+
+    def step_fn(state: TrainState, batch: Tuple[jax.Array, jax.Array]):
+        x, y = batch
+        grad_fn = jax.value_and_grad(_loss_and_metrics)
+
+        if n_micro == 1:
+            loss, grads = grad_fn(state["params"], x, y, model_cfg)
+        else:
+            b = x.shape[0]
+            xm = x.reshape(n_micro, b // n_micro, -1)
+            ym = y.reshape(n_micro, b // n_micro, -1)
+
+            def micro_step(carry, mb):
+                loss_acc, grads_acc = carry
+                mx, my = mb
+                loss, grads = grad_fn(state["params"], mx, my, model_cfg)
+                return (
+                    loss_acc + loss,
+                    jax.tree.map(jnp.add, grads_acc, grads),
+                ), None
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state["params"])
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                micro_step, (jnp.zeros((), jnp.float32), zero_grads), (xm, ym)
+            )
+            loss = loss_sum / n_micro
+            grads = jax.tree.map(lambda g: g / n_micro, grad_sum)
+
+        if tcfg.grad_clip > 0:
+            grads, grad_norm = opt.clip_by_global_norm(grads, tcfg.grad_clip)
+        else:
+            grad_norm = opt.global_norm(grads)
+
+        lr = opt.learning_rate(state["step"], tcfg)
+        new_params, new_opt = opt.adamw_update(grads, state["opt"], state["params"], lr, tcfg)
+        new_state = {"params": new_params, "opt": new_opt, "step": state["step"] + 1}
+        metrics = {"loss": loss, "grad_norm": grad_norm, "lr": lr}
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=0)
+
+    def traced(state, batch):
+        with activation_mesh(mesh):
+            return step_fn(state, batch)
+
+    # Shardings are derived from the live state at first call (the pytree
+    # structure depends on model flags), then the compiled fn is memoized.
+    batch_sharding = NamedSharding(mesh, batch_pspec(model_cfg.sequence_parallel))
+    compiled_cache: Dict[Any, Any] = {}
+
+    def wrapper(state, batch):
+        key = jax.tree.structure(state)
+        fn = compiled_cache.get(key)
+        if fn is None:
+            state_shardings = named_sharding_tree(mesh, state_pspec_tree(state))
+            fn = jax.jit(
+                traced,
+                in_shardings=(state_shardings, (batch_sharding, batch_sharding)),
+                out_shardings=(state_shardings, None),
+                donate_argnums=0,
+            )
+            compiled_cache[key] = fn
+        return fn(state, batch)
+
+    return wrapper
+
+
+def build_eval_step(
+    cfg: Config, mesh: Optional[Mesh] = None
+) -> Callable[[TrainState, Tuple[jax.Array, jax.Array]], jax.Array]:
+    model_cfg = cfg.model
+
+    def eval_fn(state: TrainState, batch):
+        x, y = batch
+        with activation_mesh(mesh):
+            return transformer.loss_fn(state["params"], x, y, model_cfg)
+
+    return jax.jit(eval_fn)

@@ -1,0 +1,23 @@
+"""Platform selection for entry points.
+
+`PLLM_PLATFORM=cpu|tpu` forces the JAX backend before first device use —
+needed because some environments pin `JAX_PLATFORMS` at the process level
+(e.g. a preregistered TPU plugin) where the env var alone cannot be
+overridden from the command line. `PLLM_CPU_DEVICES=N` additionally requests
+N virtual CPU devices (multi-chip simulation off-hardware).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    platform = os.environ.get("PLLM_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        n = os.environ.get("PLLM_CPU_DEVICES")
+        if platform == "cpu" and n:
+            jax.config.update("jax_num_cpu_devices", int(n))

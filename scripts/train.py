@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Training entry point.
+
+Mirror of the reference CLI (`/root/reference/scripts/train_transformer.py`),
+redesigned: presets + dotted overrides instead of a mutable global dict, JAX
+multi-host init instead of torchrun env vars, `--data synthetic` for a
+zero-setup smoke run.
+
+Examples:
+  python scripts/train.py --preset tiny --data synthetic --override train.train_steps=100
+  python scripts/train.py --preset gpt2-124m \
+      --override data.train_path=data/train.bin data.val_path=data/val.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+from pretraining_llm_tpu.parallel.mesh import initialize_distributed
+
+# Must run before anything touches a device (see mesh.initialize_distributed).
+initialize_distributed()
+
+import jax  # noqa: E402
+
+from pretraining_llm_tpu.config import get_preset, list_presets  # noqa: E402
+from pretraining_llm_tpu.training.trainer import Trainer  # noqa: E402
+
+
+def parse_overrides(pairs):
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"override must be key=value, got {pair!r}")
+        key, raw = pair.split("=", 1)
+        try:
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            out[key] = raw  # plain string
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--preset", default="gpt2-124m", help=f"one of {list_presets()}")
+    parser.add_argument(
+        "--override", nargs="*", default=[], metavar="SECTION.KEY=VALUE",
+        help="dotted config overrides, e.g. train.lr=1e-4",
+    )
+    parser.add_argument(
+        "--data", default="files", choices=["files", "synthetic"],
+        help="'synthetic' trains on a generated Markov stream (no files needed)",
+    )
+    parser.add_argument("--no-resume", action="store_true", help="ignore existing checkpoints")
+    parser.add_argument("--steps", type=int, default=None, help="override total steps")
+    args = parser.parse_args()
+
+    config = get_preset(args.preset).with_overrides(parse_overrides(args.override))
+    if jax.process_index() == 0:
+        print(f"preset={config.name} devices={jax.device_count()} "
+              f"params={config.model.num_params()/1e6:.1f}M")
+    trainer = Trainer(config, synthetic_data=(args.data == "synthetic"), resume=not args.no_resume)
+    final = trainer.train(steps=args.steps)
+    if jax.process_index() == 0:
+        print("final:", final)
+
+
+if __name__ == "__main__":
+    main()

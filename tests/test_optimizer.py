@@ -1,0 +1,80 @@
+"""In-repo AdamW vs optax reference; schedules; clipping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pretraining_llm_tpu.config import TrainConfig
+from pretraining_llm_tpu.training import optimizer as opt
+
+
+def _params(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "blocks": {
+            "mlp": {"w1": jax.random.normal(k1, (4, 8)), "b1": jnp.zeros((8,))},
+        },
+        "tok_embed": {"embedding": jax.random.normal(k2, (16, 4))},
+        "final_norm": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+
+
+def test_adamw_matches_optax():
+    cfg = TrainConfig(lr=1e-3, weight_decay=0.1, adam_b1=0.9, adam_b2=0.95, adam_eps=1e-8)
+    params = _params(jax.random.key(0))
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+
+    mask = opt.decay_mask(params)
+    ref_tx = optax.chain(
+        optax.scale_by_adam(b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps),
+        optax.add_decayed_weights(cfg.weight_decay, mask=mask),
+        optax.scale(-cfg.lr),
+    )
+    ref_state = ref_tx.init(params)
+    ours_state = opt.adamw_init(params)
+
+    p_ref, p_ours = params, params
+    for _ in range(5):
+        updates, ref_state = ref_tx.update(grads, ref_state, p_ref)
+        p_ref = optax.apply_updates(p_ref, updates)
+        p_ours, ours_state = opt.adamw_update(grads, ours_state, p_ours, jnp.float32(cfg.lr), cfg)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        p_ref,
+        p_ours,
+    )
+
+
+def test_decay_mask_excludes_biases_and_norms():
+    params = _params(jax.random.key(0))
+    mask = opt.decay_mask(params)
+    assert mask["blocks"]["mlp"]["w1"] is True
+    assert mask["blocks"]["mlp"]["b1"] is False
+    assert mask["tok_embed"]["embedding"] is True
+    assert mask["final_norm"]["scale"] is False
+    assert mask["final_norm"]["bias"] is False
+
+
+def test_clip_by_global_norm():
+    grads = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+    clipped, norm = opt.clip_by_global_norm(grads, 1.0)
+    np.testing.assert_allclose(float(norm), np.sqrt(3 * 16 + 4 * 9), rtol=1e-6)
+    np.testing.assert_allclose(float(opt.global_norm(clipped)), 1.0, rtol=1e-4)
+    # Under the limit: untouched
+    same, _ = opt.clip_by_global_norm(grads, 100.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), np.asarray(grads["a"]), rtol=1e-6)
+
+
+def test_lr_schedules():
+    cfg = TrainConfig(lr=1e-3, train_steps=1000, warmup_frac=0.1, lr_schedule="warmup_constant")
+    lrs = [float(opt.learning_rate(jnp.int32(s), cfg)) for s in [0, 50, 99, 100, 500, 999]]
+    assert lrs[0] < lrs[1] < lrs[2] <= 1e-3 + 1e-9
+    np.testing.assert_allclose(lrs[3:], 1e-3, rtol=1e-5)
+
+    cfg = TrainConfig(lr=1e-3, train_steps=1000, warmup_frac=0.1, lr_schedule="warmup_cosine", min_lr_frac=0.1)
+    mid = float(opt.learning_rate(jnp.int32(550), cfg))
+    end = float(opt.learning_rate(jnp.int32(999), cfg))
+    assert 1e-4 < mid < 1e-3
+    np.testing.assert_allclose(end, 1e-4, rtol=0.05)
