@@ -1,0 +1,159 @@
+"""In-repo byte-level BPE: trainable, serializable, tiktoken-compatible API.
+
+The reference outsources tokenization to tiktoken's pretrained Rust BPE
+(`/root/reference/scripts/data_preprocess.py:29-34`). This framework supplies
+its own equivalent so the data pipeline is self-contained:
+
+  - `ByteTokenizer`: the degenerate no-merge case — raw UTF-8 bytes + an
+    <|endoftext|> id. Always available, zero data files.
+  - `BPETokenizer`: byte-level BPE trained on your own corpus (merges stored
+    as JSON). Same `encode_ordinary` / `decode` / `eot_token` / `n_vocab`
+    surface as tiktoken's `Encoding`, so the preprocess/generate paths take
+    either interchangeably.
+
+Tokenization is host-side and offline — never on the device path — so pure
+Python is acceptable here; the hot encode loop is replaced by the C++ runtime
+extension when built (native/, ctypes-loaded).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens; id 256 is <|endoftext|>."""
+
+    n_vocab = 257
+
+    @property
+    def eot_token(self) -> int:
+        return 256
+
+    def encode_ordinary(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def encode(self, text: str) -> List[int]:
+        return self.encode_ordinary(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class BPETokenizer:
+    """Byte-level BPE with an explicit merge list.
+
+    Encoding applies merges in priority order (lowest rank first) — the
+    standard BPE greedy scheme. Training is iterative highest-frequency pair
+    merging over a sample corpus.
+    """
+
+    def __init__(self, merges: List[Tuple[int, int]], special_tokens: Dict[str, int] | None = None):
+        self.merges = [tuple(m) for m in merges]
+        self.ranks: Dict[Tuple[int, int], int] = {m: i for i, m in enumerate(self.merges)}
+        # token id space: 0..255 bytes, 256+i for merge i, then specials
+        self.special_tokens = special_tokens or {"<|endoftext|>": 256 + len(self.merges)}
+        self._decode_table: Dict[int, bytes] = {i: bytes([i]) for i in range(256)}
+        for i, (a, b) in enumerate(self.merges):
+            self._decode_table[256 + i] = self._decode_table[a] + self._decode_table[b]
+
+    # -- tiktoken-compatible surface ------------------------------------
+    @property
+    def n_vocab(self) -> int:
+        return 256 + len(self.merges) + len(self.special_tokens)
+
+    @property
+    def eot_token(self) -> int:
+        return self.special_tokens["<|endoftext|>"]
+
+    def encode_ordinary(self, text: str) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if not self.ranks:
+            return ids
+        while len(ids) >= 2:
+            # find the lowest-rank adjacent pair
+            best_rank = None
+            best_pos = -1
+            for pos in range(len(ids) - 1):
+                rank = self.ranks.get((ids[pos], ids[pos + 1]))
+                if rank is not None and (best_rank is None or rank < best_rank):
+                    best_rank, best_pos = rank, pos
+            if best_rank is None:
+                break
+            merged_id = 256 + best_rank
+            out = []
+            i = 0
+            while i < len(ids):
+                if (
+                    i < len(ids) - 1
+                    and ids[i] == self.merges[best_rank][0]
+                    and ids[i + 1] == self.merges[best_rank][1]
+                ):
+                    out.append(merged_id)
+                    i += 2
+                else:
+                    out.append(ids[i])
+                    i += 1
+            ids = out
+        return ids
+
+    def encode(self, text: str) -> List[int]:
+        return self.encode_ordinary(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        specials = set(self.special_tokens.values())
+        data = b"".join(self._decode_table[i] for i in ids if i not in specials)
+        return data.decode("utf-8", errors="replace")
+
+    # -- training / persistence ----------------------------------------
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int) -> "BPETokenizer":
+        """Train merges until vocab_size (>= 257) is reached."""
+        n_merges = max(0, vocab_size - 257)
+        # Work on word-like chunks to bound pair interactions (whitespace split
+        # keeps training tractable without a regex pre-tokenizer).
+        words = Counter()
+        for text in texts:
+            for word in text.split(" "):
+                words[tuple((" " + word).encode("utf-8"))] += 1
+        merges: List[Tuple[int, int]] = []
+        for merge_index in range(n_merges):
+            pairs: Counter = Counter()
+            for word, freq in words.items():
+                for a, b in zip(word, word[1:]):
+                    pairs[(a, b)] += freq
+            if not pairs:
+                break
+            best = max(pairs, key=lambda p: (pairs[p], -p[0], -p[1]))
+            if pairs[best] < 2:
+                break
+            new_id = 256 + merge_index
+            merges.append(best)
+            new_words = Counter()
+            for word, freq in words.items():
+                out = []
+                i = 0
+                while i < len(word):
+                    if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+                        out.append(new_id)
+                        i += 2
+                    else:
+                        out.append(word[i])
+                        i += 1
+                new_words[tuple(out)] += freq
+            words = new_words
+        return cls(merges)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {"merges": self.merges, "special_tokens": self.special_tokens}, f
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            raw = json.load(f)
+        return cls([tuple(m) for m in raw["merges"]], raw.get("special_tokens"))

@@ -1,0 +1,43 @@
+"""Tokenizer access: GPT-2 BPE (tiktoken) / in-repo BPE / byte fallback.
+
+The reference uses tiktoken 'gpt2' in preprocessing and 'r50k_base' in
+generation — the same vocab under two names (SURVEY §A B9); one accessor here
+keeps that consistent. Tokenization is host-side and offline; it never touches
+the device path (SURVEY §2.4).
+
+Names:
+  'gpt2' / 'r50k_base'  tiktoken's pretrained GPT-2 BPE. Requires its data
+                        file (network or TIKTOKEN_CACHE_DIR) — raises a clear
+                        error in air-gapped environments.
+  'byte'                raw UTF-8 bytes + <|endoftext|> (always available).
+  '<path>.json'         an in-repo BPETokenizer trained with
+                        `pretraining_llm_tpu.data.bpe.BPETokenizer.train`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from pretraining_llm_tpu.data.bpe import BPETokenizer, ByteTokenizer
+
+
+@functools.lru_cache(maxsize=4)
+def get_tokenizer(name: str = "gpt2") -> Any:
+    if name == "byte":
+        return ByteTokenizer()
+    if name.endswith(".json"):
+        return BPETokenizer.load(name)
+    if name in ("gpt2", "r50k_base"):
+        import tiktoken
+
+        try:
+            return tiktoken.get_encoding("gpt2")
+        except Exception as e:  # offline and uncached
+            raise RuntimeError(
+                "tiktoken could not load the GPT-2 BPE data (offline without a "
+                "TIKTOKEN_CACHE_DIR cache). Use tokenizer_name='byte', or train "
+                "an in-repo BPE (pretraining_llm_tpu.data.bpe.BPETokenizer.train) "
+                "and pass its .json path as tokenizer_name."
+            ) from e
+    raise ValueError(f"unknown tokenizer {name!r}")

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Download a pretraining dataset into the HF cache.
+
+Mirror of `/root/reference/scripts/data_download.py:7-23` (openwebtext by
+default, prints a sample), with a clear failure mode in air-gapped
+environments instead of a deep urllib traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def download_dataset(name: str = "openwebtext") -> None:
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset(name, split="train", trust_remote_code=True)
+    except Exception as e:
+        raise SystemExit(
+            f"could not download {name!r} ({type(e).__name__}: {e}). Offline? "
+            "Use `scripts/data_preprocess.py --input <files>` on a local corpus instead."
+        )
+    print(f"{name}: {len(ds)} documents cached")
+    print("sample:", ds[0]["text"][:200].replace("\n", " "))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dataset", default="openwebtext")
+    args = parser.parse_args()
+    download_dataset(args.dataset)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Tokenize a corpus into train/val uint16 memmaps.
+
+Mirror of `/root/reference/scripts/data_preprocess.py` (HF dataset -> tiktoken
+-> uint16 .bin), extended to local files and in-repo tokenizers so it runs
+offline.
+
+Examples:
+  python scripts/data_preprocess.py --input my_corpus.txt --out_dir data --tokenizer byte
+  python scripts/data_preprocess.py --dataset openwebtext --out_dir data --tokenizer gpt2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pretraining_llm_tpu.data.preprocess import preprocess
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", nargs="*", default=None, help=".txt or .jsonl files")
+    parser.add_argument("--dataset", default=None, help="HF dataset name (needs cache/network)")
+    parser.add_argument("--out_dir", default="data")
+    parser.add_argument("--tokenizer", default="gpt2", help="gpt2 | byte | path/to/bpe.json")
+    parser.add_argument("--val_fraction", type=float, default=0.0005)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--num_proc", type=int, default=None)
+    parser.add_argument("--max_docs", type=int, default=None)
+    args = parser.parse_args()
+
+    preprocess(
+        input_files=args.input,
+        dataset_name=args.dataset,
+        out_dir=args.out_dir,
+        tokenizer_name=args.tokenizer,
+        val_fraction=args.val_fraction,
+        seed=args.seed,
+        num_proc=args.num_proc,
+        max_docs=args.max_docs,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Offline preprocess pipeline: text files -> token memmaps -> loader."""
+
+import numpy as np
+
+from pretraining_llm_tpu.data import loader
+from pretraining_llm_tpu.data.preprocess import preprocess, split_documents, write_token_file
+from pretraining_llm_tpu.data.tokenizer import get_tokenizer
+
+
+def test_split_is_deterministic():
+    docs = [f"doc {i}" for i in range(100)]
+    t1, v1 = split_documents(docs, 0.1, seed=42)
+    t2, v2 = split_documents(docs, 0.1, seed=42)
+    assert t1 == t2 and v1 == v2
+    assert len(v1) == 10
+    assert set(t1) | set(v1) == set(docs)
+
+
+def test_write_token_file_roundtrip(tmp_path):
+    docs = ["hello world", "goodbye world"]
+    path = str(tmp_path / "toks.bin")
+    n = write_token_file(docs, path, "byte", num_proc=1)
+    tok = get_tokenizer("byte")
+    data = np.memmap(path, dtype=np.uint16, mode="r")
+    assert len(data) == n
+    # Contents: doc1 bytes + eot + doc2 bytes + eot
+    want = tok.encode_ordinary(docs[0]) + [tok.eot_token] + tok.encode_ordinary(docs[1]) + [tok.eot_token]
+    np.testing.assert_array_equal(np.asarray(data), want)
+
+
+def test_preprocess_end_to_end_feeds_loader(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog. " * 500)
+    train_path, val_path = preprocess(
+        input_files=[str(corpus)],
+        out_dir=str(tmp_path / "data"),
+        tokenizer_name="byte",
+        val_fraction=0.05,
+        num_proc=1,
+    )
+    it = loader.get_batch_iterator(train_path, batch_size=2, context_length=32, seed=0)
+    x, y = next(it)
+    assert x.shape == (2, 32)
+    assert (x < 257).all()
+    itv = loader.get_batch_iterator(val_path, batch_size=1, context_length=32, seed=0)
+    next(itv)
+
+
+def test_preprocess_jsonl(tmp_path):
+    import json
+
+    jl = tmp_path / "docs.jsonl"
+    jl.write_text("\n".join(json.dumps({"text": f"document number {i} " * 30}) for i in range(20)))
+    train_path, val_path = preprocess(
+        input_files=[str(jl)],
+        out_dir=str(tmp_path / "data"),
+        tokenizer_name="byte",
+        val_fraction=0.1,
+        num_proc=1,
+    )
+    data = np.memmap(train_path, dtype=np.uint16, mode="r")
+    assert len(data) > 100

@@ -1,0 +1,54 @@
+"""In-repo tokenizers: byte fallback and trainable BPE."""
+
+import pytest
+
+from pretraining_llm_tpu.data.bpe import BPETokenizer, ByteTokenizer
+from pretraining_llm_tpu.data.tokenizer import get_tokenizer
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "Hello, TPU world! éàü"
+    ids = tok.encode_ordinary(text)
+    assert all(0 <= i < 256 for i in ids)
+    assert tok.decode(ids) == text
+    assert tok.eot_token == 256
+    assert tok.n_vocab == 257
+
+
+def test_bpe_train_and_roundtrip():
+    corpus = ["the quick brown fox jumps over the lazy dog " * 20,
+              "the quick red fox runs over the sleepy cat " * 20]
+    tok = BPETokenizer.train(corpus, vocab_size=300)
+    assert 257 <= tok.n_vocab <= 300
+    text = "the quick fox"
+    ids = tok.encode_ordinary(text)
+    assert tok.decode(ids) == text
+    # Merges actually compress: fewer tokens than bytes.
+    assert len(ids) < len(text.encode())
+
+
+def test_bpe_save_load(tmp_path):
+    corpus = ["aaa bbb aaa bbb aaa bbb " * 30]
+    tok = BPETokenizer.train(corpus, vocab_size=280)
+    path = str(tmp_path / "bpe.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    text = "aaa bbb"
+    assert tok.encode_ordinary(text) == tok2.encode_ordinary(text)
+    assert tok2.decode(tok2.encode_ordinary(text)) == text
+    # get_tokenizer dispatches on .json path
+    tok3 = get_tokenizer(path)
+    assert tok3.encode_ordinary(text) == tok.encode_ordinary(text)
+
+
+def test_bpe_handles_unseen_bytes():
+    tok = BPETokenizer.train(["abc abc abc " * 10], vocab_size=270)
+    text = "xyz ☃"  # snowman: multibyte UTF-8 never seen in training
+    assert tok.decode(tok.encode_ordinary(text)) == text
+
+
+def test_get_tokenizer_byte_and_unknown():
+    assert get_tokenizer("byte").n_vocab == 257
+    with pytest.raises(ValueError):
+        get_tokenizer("nonsense")
