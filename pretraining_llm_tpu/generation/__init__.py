@@ -1,0 +1,2 @@
+from pretraining_llm_tpu.generation.generate import generate, generate_text  # noqa: F401
+from pretraining_llm_tpu.generation.sampling import sample_logits  # noqa: F401

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Sampling CLI — mirror of the reference's `scripts/generate_text.py`
+interface (`--model_path --input_text --max_new_tokens`,
+/root/reference/scripts/generate_text.py:49-58), extended with sampling knobs.
+
+Example:
+  python scripts/generate_text.py --model_path checkpoints \
+      --input_text "Once upon a time" --max_new_tokens 100 --temperature 0.8 --top_k 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+from pretraining_llm_tpu.generation.generate import generate_text
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model_path", required=True, help="checkpoint dir (or a step-N dir)")
+    parser.add_argument("--input_text", required=True)
+    parser.add_argument("--max_new_tokens", type=int, default=100)
+    parser.add_argument("--temperature", type=float, default=1.0, help="0 = greedy")
+    parser.add_argument("--top_k", type=int, default=None)
+    parser.add_argument("--top_p", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    text = generate_text(
+        args.model_path,
+        args.input_text,
+        args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        seed=args.seed,
+    )
+    print(text)
+
+
+if __name__ == "__main__":
+    main()
