@@ -76,15 +76,20 @@ def multihead_attention(
 ) -> jax.Array:
     """Dispatch over attention implementations.
 
-    'ring' is not dispatched here: ring attention changes the *sharding* of the
-    whole forward pass, so the model layer invokes it via
-    `parallel.ring_attention` when `cfg.attention_impl == 'ring'` and a seq
-    axis is active; off-mesh it degrades to this dispatcher.
+    'ring' routes to `parallel.ring_attention` (shard_map over the active
+    mesh's 'seq' axis, read from `parallel.sharding.current_mesh()` at trace
+    time). Without a seq axis, or for KV-cached decode (kv_mask set), it
+    degrades to the dense path — the correct single-shard form.
     """
     if impl == "ring":
-        # Ring attention reshards the whole forward (seq axis); when the model
-        # layer reaches this dispatcher with impl='ring' the mesh had no seq
-        # axis, so the dense path is the correct degenerate form.
+        from pretraining_llm_tpu.parallel.ring_attention import ring_attention
+        from pretraining_llm_tpu.parallel.sharding import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("seq", 1) > 1 and kv_mask is None:
+            return ring_attention(q, k, v, mesh, causal=causal)
+        # No seq axis on the active mesh (or cached decode): the dense path is
+        # the correct degenerate form.
         impl = "naive"
     if impl == "naive":
         return naive_attention(
