@@ -1,0 +1,111 @@
+"""Ring attention: context parallelism over the 'seq' mesh axis.
+
+The reference cannot exceed context 512 — attention materializes full (B,T,T)
+scores per head (/root/reference/src/models/attention.py:51-57) and there is
+no sequence/context parallelism of any kind (SURVEY §2.2). This module scales
+context across chips the TPU way:
+
+  - the sequence dim of q/k/v is sharded over the 'seq' mesh axis
+    (`jax.shard_map`);
+  - each device keeps its q shard resident and the K/V shards rotate around
+    the ring with `jax.lax.ppermute` (ICI neighbor hops), one hop per step;
+  - partial attention per (q-shard, kv-shard) pair merges into running
+    online-softmax stats (max m, sum l, unnormalized accumulator) — the same
+    math as the flash kernel, lifted one level up to the inter-chip ring;
+  - causal masking is global-position index arithmetic: kv shards entirely in
+    the future contribute nothing (their block's scores mask to -inf).
+
+Memory per device: O(T/n) activations and one in-flight KV shard — 8k+
+contexts at the per-chip cost of 8k/n. Compute per step maps to the MXU via
+batched einsums; the ppermute overlaps with the next partial-attention block
+under XLA's async collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device body. q, k, v: (B, T_local, H, Dh) shards."""
+    my = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    qf = q.astype(jnp.float32)
+    q_pos = my * tl + jnp.arange(tl)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, r):
+        o_acc, m, l, kc, vc = carry
+        src = (my - r) % axis_size  # owner of the kv shard currently held
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            k_pos = src * tl + jnp.arange(tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, H, Tl)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])  # rows with no valid keys -> ~0
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o_acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        # Rotate KV to the next device; the final rotation restores ownership.
+        kc, vc = jax.lax.ppermute((kc, vc), axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    o0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    m0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    (o_acc, _, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (o_acc / safe_l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    seq_axis: str = "seq",
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jax.Array:
+    """Global-view entry: q, k, v (B, T, H, Dh) with T sharded over seq_axis.
+
+    Nested inside the jitted forward via shard_map; degenerates to a single
+    local block (no communication) when the seq axis has size 1.
+    """
+    axis_size = mesh.shape[seq_axis]
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    local = functools.partial(
+        _ring_local, causal=causal, axis_name=seq_axis, axis_size=axis_size
+    )
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
