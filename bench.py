@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Benchmark: training throughput + MFU for the flagship config on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The BASELINE.json target is >=50% MFU on the 124M GPT-2 config;
+`vs_baseline` is measured_MFU / 0.50 (1.0 = target met).
+
+Usage:
+  python bench.py             # full run (gpt2-124m, auto batch)
+  python bench.py --quick     # fewer steps, for smoke testing
+  python bench.py --preset gpt2-350m-dp --batch 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax
+import jax.numpy as jnp
+
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.data import loader
+from pretraining_llm_tpu.parallel.mesh import build_mesh
+from pretraining_llm_tpu.training import train_step as ts
+from pretraining_llm_tpu.utils.hardware import device_peak_flops
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="gpt2-124m")
+    parser.add_argument("--batch", type=int, default=0, help="global batch (0 = preset default)")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
+    args = parser.parse_args()
+
+    cfg = get_preset(args.preset)
+    model = cfg.model
+    if args.attention:
+        model = dataclasses.replace(model, attention_impl=args.attention)
+    elif model.attention_impl == "ring":
+        model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
+    # Memory-conscious defaults for a single chip: remat the blocks.
+    if model.remat == "none":
+        model = dataclasses.replace(model, remat="dots_saveable")
+    batch = args.batch or cfg.train.batch_size
+    if args.quick:
+        args.steps, args.warmup, batch = 5, 2, min(batch, 4)
+    cfg = cfg.replace(model=model, train=dataclasses.replace(cfg.train, batch_size=batch))
+
+    n_dev = jax.device_count()
+    mesh = build_mesh(cfg.mesh) if n_dev > 1 else None
+    state = ts.init_train_state(cfg, jax.random.key(0))
+    if mesh is not None:
+        state = ts.shard_train_state(state, mesh)
+    step = ts.build_train_step(cfg, mesh)
+
+    it = loader.synthetic_iterator(model.vocab_size, model.context_length, batch, seed=0)
+    x, y = next(it)
+    batch_dev = (jnp.asarray(x), jnp.asarray(y))
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch_dev)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, batch_dev)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = args.steps * batch * model.context_length
+    tok_per_sec = tokens / dt
+    flops_per_token = model.flops_per_token()
+    peak = device_peak_flops() * n_dev
+    mfu = tok_per_sec * flops_per_token / peak
+
+    result = {
+        "metric": f"mfu_{cfg.name}_train",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "tokens_per_sec_chip": round(tok_per_sec / n_dev, 1),
+        "step_ms": round(dt / args.steps * 1e3, 2),
+        "batch": batch,
+        "context_length": model.context_length,
+        "params_m": round(model.num_params() / 1e6, 1),
+        "attention": model.attention_impl,
+        "device": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "loss_finite": bool(jnp.isfinite(metrics["loss"])),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
