@@ -89,6 +89,8 @@ class BatchIterator:
         return {"bit_generator": self._rng.bit_generator.state}
 
     def set_state(self, state: Dict[str, Any]) -> None:
+        if "bit_generator" not in state:
+            return  # checkpoint written by a different iterator backend
         self._rng.bit_generator.state = state["bit_generator"]
 
 

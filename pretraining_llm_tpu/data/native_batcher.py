@@ -1,0 +1,142 @@
+"""ctypes bindings for the native C++ batch gatherer (native/batcher.cpp).
+
+Auto-builds `native/libbatcher.so` with `make` on first use when a toolchain
+is present; callers fall back to the pure-numpy loader otherwise (the Trainer
+does this automatically). The native iterator is counter-based: its full
+sampling state is one integer, which makes checkpoint resume trivially exact.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbatcher.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            if not auto_build:
+                return None
+            try:
+                subprocess.run(
+                    ["make", "-s", "libbatcher.so"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (subprocess.SubprocessError, FileNotFoundError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.batcher_open.restype = ctypes.c_void_p
+        lib.batcher_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.batcher_num_tokens.restype = ctypes.c_int64
+        lib.batcher_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.batcher_sample.restype = None
+        lib.batcher_sample.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.batcher_close.restype = None
+        lib.batcher_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class NativeBatchIterator:
+    """Drop-in for data.loader.BatchIterator, backed by the C++ gatherer."""
+
+    def __init__(
+        self,
+        data_path: str,
+        batch_size: int,
+        context_length: int,
+        *,
+        seed: int = 1337,
+        shard_index: int = 0,
+        shard_count: int = 1,
+        n_threads: int = 4,
+    ) -> None:
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native batcher library unavailable (no toolchain?)")
+        self._lib = lib
+        self._handle = lib.batcher_open(
+            data_path.encode(), context_length, shard_index, shard_count, n_threads
+        )
+        if not self._handle:
+            raise ValueError(
+                f"{data_path}: could not open (missing, or shard smaller than "
+                f"context_length+1={context_length + 1})"
+            )
+        self.batch_size = batch_size
+        self.context_length = context_length
+        self.seed = seed
+        self.counter = 0
+        self._x = np.empty((batch_size, context_length), np.int32)
+        self._y = np.empty((batch_size, context_length), np.int32)
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self._lib.batcher_num_tokens(self._handle))
+
+    def __iter__(self) -> "NativeBatchIterator":
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        self._lib.batcher_sample(
+            self._handle,
+            ctypes.c_uint64(self.seed),
+            ctypes.c_uint64(self.counter),
+            self.batch_size,
+            self._x.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        self.counter += 1
+        # Copies: the internal buffers are reused next call.
+        return self._x.copy(), self._y.copy()
+
+    # Checkpointable sampling state: just the counter (counter-based PRNG).
+    def state(self) -> Dict[str, Any]:
+        return {"native_counter": self.counter, "seed": self.seed}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        if "native_counter" not in state:
+            return  # checkpoint written by a different iterator backend
+        self.counter = int(state["native_counter"])
+        self.seed = int(state.get("seed", self.seed))
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.batcher_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -66,22 +66,8 @@ class Trainer:
                     mcfg.vocab_size, mcfg.context_length, tcfg.batch_size, dcfg.sample_seed + 1
                 )
             else:
-                train_iterator = data_loader.get_batch_iterator(
-                    dcfg.train_path,
-                    tcfg.batch_size,
-                    mcfg.context_length,
-                    seed=dcfg.sample_seed,
-                    shard_index=jax.process_index(),
-                    shard_count=jax.process_count(),
-                )
-                val_iterator = data_loader.get_batch_iterator(
-                    dcfg.val_path,
-                    tcfg.batch_size,
-                    mcfg.context_length,
-                    seed=dcfg.sample_seed + 1,
-                    shard_index=jax.process_index(),
-                    shard_count=jax.process_count(),
-                )
+                train_iterator = self._make_iterator(dcfg.train_path, dcfg.sample_seed)
+                val_iterator = self._make_iterator(dcfg.val_path, dcfg.sample_seed + 1)
         self.train_iterator = train_iterator
         self.val_iterator = val_iterator
 
@@ -114,6 +100,32 @@ class Trainer:
         else:
             state = jax.device_put(state)
         self.state = state
+
+    def _make_iterator(self, path: str, seed: int):
+        """File iterator: native C++ gatherer when built, numpy otherwise."""
+        dcfg, tcfg, mcfg = self.config.data, self.config.train, self.config.model
+        if dcfg.use_native_batcher:
+            try:
+                from pretraining_llm_tpu.data.native_batcher import NativeBatchIterator
+
+                return NativeBatchIterator(
+                    path,
+                    tcfg.batch_size,
+                    mcfg.context_length,
+                    seed=seed,
+                    shard_index=jax.process_index(),
+                    shard_count=jax.process_count(),
+                )
+            except (RuntimeError, ValueError):
+                pass  # no toolchain / unreadable: numpy path below
+        return data_loader.get_batch_iterator(
+            path,
+            tcfg.batch_size,
+            mcfg.context_length,
+            seed=seed,
+            shard_index=jax.process_index(),
+            shard_count=jax.process_count(),
+        )
 
     # ------------------------------------------------------------------
     def evaluate(self, iters: Optional[int] = None) -> float:

@@ -1,0 +1,105 @@
+"""Native C++ batch gatherer: build, correctness vs source stream, determinism."""
+
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.data.native_batcher import NativeBatchIterator, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain to build the native batcher"
+)
+
+
+@pytest.fixture()
+def token_file(tmp_path):
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 60000, size=50_000, dtype=np.uint16)
+    path = tmp_path / "train.bin"
+    tokens.tofile(path)
+    return str(path), tokens
+
+
+def test_batches_are_verbatim_windows(token_file):
+    path, tokens = token_file
+    it = NativeBatchIterator(path, batch_size=8, context_length=32, seed=1)
+    x, y = next(it)
+    assert x.shape == (8, 32) and x.dtype == np.int32
+    flat = tokens.astype(np.int32)
+    for xr, yr in zip(x, y):
+        # x must be a verbatim window, y its shift-by-one
+        matches = np.where(flat[: len(flat) - 33] == xr[0])[0]
+        assert any(
+            np.array_equal(flat[m : m + 32], xr) and np.array_equal(flat[m + 1 : m + 33], yr)
+            for m in matches
+        )
+
+
+def test_counter_determinism_and_state_roundtrip(token_file):
+    path, _ = token_file
+    a = NativeBatchIterator(path, 4, 16, seed=7)
+    b = NativeBatchIterator(path, 4, 16, seed=7)
+    for _ in range(3):
+        xa, _ = next(a)
+        xb, _ = next(b)
+        np.testing.assert_array_equal(xa, xb)
+    # State is just the counter: replay from saved state matches.
+    saved = a.state()
+    x1, _ = next(a)
+    c = NativeBatchIterator(path, 4, 16, seed=7)
+    c.set_state(saved)
+    x2, _ = next(c)
+    np.testing.assert_array_equal(x1, x2)
+    # Different seed differs.
+    d = NativeBatchIterator(path, 4, 16, seed=8)
+    assert not np.array_equal(next(d)[0], x2)
+
+
+def test_sharding_contiguous(token_file):
+    path, tokens = token_file
+    it1 = NativeBatchIterator(path, 8, 16, seed=0, shard_index=1, shard_count=2)
+    x1, _ = next(it1)
+    src1 = tokens[len(tokens) // 2 :].astype(np.int32)
+    for row in x1:
+        matches = np.where(src1[: len(src1) - 16] == row[0])[0]
+        assert any(np.array_equal(src1[m : m + 16], row) for m in matches)
+
+
+def test_multithreaded_matches_single_thread(token_file):
+    path, _ = token_file
+    a = NativeBatchIterator(path, 32, 64, seed=3, n_threads=1)
+    b = NativeBatchIterator(path, 32, 64, seed=3, n_threads=8)
+    for _ in range(3):
+        xa, ya = next(a)
+        xb, yb = next(b)
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_too_small_file_rejected(tmp_path):
+    path = tmp_path / "tiny.bin"
+    np.arange(8, dtype=np.uint16).tofile(path)
+    with pytest.raises(ValueError):
+        NativeBatchIterator(str(path), 1, 64)
+
+
+def test_trainer_uses_native_when_available(tmp_path, token_file):
+    path, _ = token_file
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.data.native_batcher import NativeBatchIterator as NBI
+    from pretraining_llm_tpu.training.trainer import Trainer
+
+    cfg = get_preset("tiny").with_overrides(
+        {
+            "model.vocab_size": 60000,
+            "data.train_path": path,
+            "data.val_path": path,
+            "train.train_steps": 2,
+            "train.checkpoint_interval": 0,
+            "train.eval_interval": 0,
+            "train.log_interval": 100,
+            "train.checkpoint_dir": str(tmp_path / "ck"),
+        }
+    )
+    t = Trainer(cfg, resume=False)
+    assert isinstance(t.train_iterator, NBI)
+    t.train()
