@@ -240,6 +240,10 @@ class TrainConfig:
     keep_checkpoints: int = 3
     log_interval: int = 10
     metrics_path: str = ""  # JSONL sink; "" = stdout only
+    debug_nans: bool = False  # op-level NaN detection (slow; debugging only)
+    profile_dir: str = ""  # capture a profiler trace window into this dir
+    profile_start: int = 10  # first step of the trace window
+    profile_steps: int = 5  # trace window length
 
     def __post_init__(self) -> None:
         if self.lr_schedule not in _LR_SCHEDULES:

@@ -46,6 +46,10 @@ class Trainer:
         logger: Optional[MetricsLogger] = None,
     ) -> None:
         self.config = config
+        if config.train.debug_nans:
+            from pretraining_llm_tpu.utils.debug import enable_nan_checks
+
+            enable_nan_checks()
         needs_mesh = jax.device_count() > 1 or any(
             s > 1 for s in (config.mesh.fsdp, config.mesh.tensor, config.mesh.seq)
         )
@@ -160,29 +164,49 @@ class Trainer:
         tokens_per_step = tcfg.batch_size * self.config.model.context_length
         is_host0 = jax.process_index() == 0
 
+        from pretraining_llm_tpu.utils.profiling import StepProfiler
+
+        profiler = StepProfiler(tcfg.profile_dir, tcfg.profile_start, tcfg.profile_steps)
+
         # Sampling is synchronous with the loop (so the checkpointed data-RNG
         # state is exactly the consumed-batch frontier — exact resume), but
         # device_put and the step dispatch are async: the host runs ahead of
         # the device until a metric sync at a log boundary.
         last: Dict[str, float] = {}
-        for step in range(self.start_step, total):
-            batch = self._put(next(self.train_iterator))
-            self.state, metrics = self.step_fn(self.state, batch)
-            tp = self.throughput.tick(tokens_per_step)
+        step = self.start_step
+        try:
+            for step in range(self.start_step, total):
+                profiler.step(step)
+                batch = self._put(next(self.train_iterator))
+                self.state, metrics = self.step_fn(self.state, batch)
+                tp = self.throughput.tick(tokens_per_step)
 
-            if (step + 1) % tcfg.log_interval == 0 or step + 1 == total:
-                last = {k: float(v) for k, v in metrics.items()}
-                last.update(tp)
-                if is_host0:
-                    self.logger.log({"step": step + 1, **last})
-            if tcfg.eval_interval > 0 and (step + 1) % tcfg.eval_interval == 0:
-                val_loss = self.evaluate()
-                last["val_loss"] = val_loss
-                if is_host0:
-                    self.logger.log({"step": step + 1, "val_loss": val_loss})
-            if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
-                if is_host0:
-                    self.save(step + 1)
+                if (step + 1) % tcfg.log_interval == 0 or step + 1 == total:
+                    last = {k: float(v) for k, v in metrics.items()}
+                    last.update(tp)
+                    if is_host0:
+                        self.logger.log({"step": step + 1, **last})
+                if tcfg.eval_interval > 0 and (step + 1) % tcfg.eval_interval == 0:
+                    val_loss = self.evaluate()
+                    last["val_loss"] = val_loss
+                    if is_host0:
+                        self.logger.log({"step": step + 1, "val_loss": val_loss})
+                if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
+                    if is_host0:
+                        self.save(step + 1)
+        except Exception as e:
+            # Failure recovery (SURVEY §5): persist the last good state before
+            # propagating. self.state is the step-(k-1) output and still valid
+            # even though the failing step's donated inputs are gone.
+            if is_host0:
+                self.logger.log({"event": "failure", "step": step, "error": repr(e)[:200]})
+                try:
+                    self.save(step)
+                except Exception as save_err:  # keep the original error primary
+                    self.logger.log({"event": "emergency_save_failed", "error": repr(save_err)[:200]})
+            raise
+        finally:
+            profiler.close()
 
         if is_host0 and (tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0):
             self.save(total)

@@ -1,0 +1,50 @@
+"""Numerics debugging: the JAX-side analog of sanitizers (SURVEY §5).
+
+The reference has no anomaly detection of any kind. Here:
+  - `enable_nan_checks()`: jax_debug_nans/jax_debug_infs — every compiled
+    function re-runs op-by-op on a NaN and pinpoints the producing primitive;
+  - `checked_loss`: a checkify-wrapped loss that turns non-finite loss and
+    out-of-range token ids into structured, jit-safe errors (usable inside
+    the compiled step, where Python asserts cannot live).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.models import transformer
+
+
+def enable_nan_checks(nans: bool = True, infs: bool = False) -> None:
+    jax.config.update("jax_debug_nans", nans)
+    jax.config.update("jax_debug_infs", infs)
+
+
+def checked_loss(
+    params: Any, tokens: jax.Array, targets: jax.Array, cfg: ModelConfig
+) -> Tuple[checkify.Error, jax.Array]:
+    """Loss with traced assertions: call via `checkify.checkify`d jit.
+
+    Example:
+        err, loss = jax.jit(functools.partial(checked_loss, cfg=cfg))(p, x, y)
+        err.throw()  # raises with the failed predicate if any
+    """
+
+    def body(params, tokens, targets):
+        checkify.check(jnp.all(tokens >= 0), "negative token id")
+        checkify.check(
+            jnp.all(tokens < cfg.vocab_size),
+            "token id out of range for vocab {v}",
+            v=jnp.int32(cfg.vocab_size),
+        )
+        loss = transformer.loss_fn(params, tokens, targets, cfg)
+        checkify.check(jnp.isfinite(loss), "non-finite loss")
+        return loss
+
+    checked = checkify.checkify(body)
+    return checked(params, tokens, targets)

@@ -1,0 +1,58 @@
+"""Tracing/profiling: jax.profiler capture around training steps.
+
+The reference's only observability is wall-clock deltas printed at eval
+boundaries (`/root/reference/scripts/train_transformer.py:75,98-101`). Here
+(SURVEY §5): on-demand XLA trace capture (TensorBoard/Perfetto-readable
+xplane dumps) scoped to a step window, plus `annotate` for named_scope
+regions that show up in the trace timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a profiler trace into `logdir` (view with TensorBoard)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named scope that appears on the profiler timeline (and in HLO names)."""
+    return jax.named_scope(name)
+
+
+class StepProfiler:
+    """Capture a [start, stop) window of training steps.
+
+    Used by the train CLI: `--profile logdir --profile_start 10 --profile_steps 5`.
+    """
+
+    def __init__(self, logdir: str, start_step: int, n_steps: int) -> None:
+        self.logdir = logdir
+        self.start_step = start_step
+        self.stop_step = start_step + n_steps
+        self._active = False
+
+    def step(self, step: int) -> None:
+        if not self.logdir:
+            return
+        if step == self.start_step and not self._active:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop_step and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
