@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 _ACTIVATIONS = ("relu", "gelu", "swiglu")
 _NORMS = ("layernorm", "rmsnorm")
 _POS_EMBEDS = ("learned", "rope")
-_ATTN_IMPLS = ("naive", "flash", "ring")
+_ATTN_IMPLS = ("naive", "flash", "ring", "ulysses")
 _REMAT_POLICIES = ("none", "full", "dots_saveable")
 
 

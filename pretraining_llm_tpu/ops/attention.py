@@ -81,13 +81,20 @@ def multihead_attention(
     time). Without a seq axis, or for KV-cached decode (kv_mask set), it
     degrades to the dense path — the correct single-shard form.
     """
-    if impl == "ring":
-        from pretraining_llm_tpu.parallel.ring_attention import ring_attention
+    if impl in ("ring", "ulysses"):
         from pretraining_llm_tpu.parallel.sharding import current_mesh
 
         mesh = current_mesh()
         if mesh is not None and mesh.shape.get("seq", 1) > 1 and kv_mask is None:
-            return ring_attention(q, k, v, mesh, causal=causal)
+            if impl == "ring":
+                from pretraining_llm_tpu.parallel.ring_attention import ring_attention
+
+                return ring_attention(q, k, v, mesh, causal=causal)
+            from pretraining_llm_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(
+                q, k, v, mesh, causal=causal, block_q=block_q, block_kv=block_kv
+            )
         # No seq axis on the active mesh (or cached decode): the dense path is
         # the correct degenerate form.
         impl = "naive"
