@@ -245,12 +245,18 @@ def forward(
     positions: Optional[jax.Array] = None,
     kv_cache: Optional[KVCache] = None,
     cache_index: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
     Training/eval: kv_cache=None. Decode: pass a stacked cache
     {'k','v'}: (L, B, Tmax, H, Dh) plus the integer write offset
     ``cache_index``; the updated cache is returned.
+
+    ``return_hidden=True`` additionally returns intermediate activations
+    {'block_outputs': (L, B, T, D), 'final_hidden': (B, T, D)} — the
+    feature-extraction hook replacing the reference's bespoke
+    ``forward_embedding`` methods (transformer.py:80-94, SURVEY §A Q3).
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t = tokens.shape
@@ -271,7 +277,7 @@ def forward(
         if kv_cache is None:
             blk = layer_inputs
             x, _ = _block(blk, x, cfg, rope, positions, None, None)
-            return x, None
+            return x, (x if return_hidden else None)
         blk, ck, cv = layer_inputs
         x, new_kv = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
         return x, new_kv
@@ -284,8 +290,9 @@ def forward(
             scan_body, policy=jax.checkpoint_policies.dots_saveable
         )
 
+    block_outputs = None
     if kv_cache is None:
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+        x, block_outputs = jax.lax.scan(body, x, params["blocks"])
         new_cache = None
     else:
         x, (new_k, new_v) = jax.lax.scan(
@@ -303,6 +310,8 @@ def forward(
     )
     if not cfg.tie_embeddings and "bias" in params.get("lm_head", {}):
         logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+    if return_hidden:
+        return logits, new_cache, {"block_outputs": block_outputs, "final_hidden": x}
     return logits, new_cache
 
 

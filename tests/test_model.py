@@ -180,3 +180,43 @@ def test_remat_matches_no_remat():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5), g1, g2
     )
+
+
+def test_return_hidden_activations():
+    """Feature-extraction hook (reference forward_embedding equivalent)."""
+    cfg = _fp32(TINY)
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    logits, cache, hidden = transformer.forward(params, tokens, cfg, return_hidden=True)
+    assert hidden["block_outputs"].shape == (cfg.n_layers, 2, 16, cfg.d_model)
+    assert hidden["final_hidden"].shape == (2, 16, cfg.d_model)
+    # The last block output, final-normed, produces the same logits path.
+    logits2, _ = transformer.forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2), atol=1e-6)
+
+
+def test_llama_variant_kv_cache_decode_matches_full():
+    """RoPE + cache positions: incremental decode == full forward (llama path)."""
+    cfg = ModelConfig(
+        vocab_size=64, context_length=32, d_model=32, n_heads=4, n_layers=2,
+        activation="swiglu", norm="rmsnorm", pos_embed="rope",
+        tie_embeddings=False, mlp_bias=False, compute_dtype="float32",
+    )
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 12), 0, 64)
+    full_logits, _ = transformer.forward(params, tokens, cfg)
+    cache = transformer.make_kv_cache(cfg, 1, 12, dtype="float32")
+    logits_p, cache = transformer.forward(
+        params, tokens[:, :6], cfg, kv_cache=cache, cache_index=jnp.int32(0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(full_logits[:, :6]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(6, 12):
+        step_logits, cache = transformer.forward(
+            params, tokens[:, i : i + 1], cfg, kv_cache=cache, cache_index=jnp.int32(i)
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, i]),
+            rtol=2e-4, atol=2e-4,
+        )
