@@ -453,12 +453,14 @@ _register(
     ),
 )
 
-# Tiny config for tests and smoke runs.
+# Tiny config for tests and smoke runs. Byte tokenizer: vocab 256 can't hold
+# GPT-2 BPE ids, and byte-level needs no downloaded vocab files.
 _register(
     "tiny",
     Config(
         model=_gpt2_model(vocab_size=256, context_length=64, d_model=32, n_heads=4, n_layers=2),
         mesh=MeshConfig(),
+        data=DataConfig(tokenizer_name="byte"),
         train=TrainConfig(batch_size=8, train_steps=50, eval_interval=20, eval_iters=2, lr=1e-3),
     ),
 )

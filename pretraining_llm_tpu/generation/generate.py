@@ -144,13 +144,17 @@ def generate_text(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     seed: int = 0,
+    tokenizer: Optional[str] = None,
 ) -> str:
     """Mirror of the reference's `generate_text(model_path, input_text,
-    max_new_tokens)` (generate_text.py:7): checkpoint -> text continuation."""
+    max_new_tokens)` (generate_text.py:7): checkpoint -> text continuation.
+
+    `tokenizer` overrides the name stored in the checkpoint's config (e.g. a
+    checkpoint trained elsewhere whose BPE files aren't available here)."""
     from pretraining_llm_tpu.data.tokenizer import get_tokenizer
 
     params, cfg = load_model_for_inference(model_path)
-    enc = get_tokenizer(cfg.data.tokenizer_name)
+    enc = get_tokenizer(tokenizer or cfg.data.tokenizer_name)
     ids = np.asarray(enc.encode_ordinary(input_text), np.int32)[None, :]
     out = generate(
         params,

@@ -102,7 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causa
         l = l_scr[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+        lse_ref[0] = m_scr[:] + jnp.log(safe_l)  # (bq, 1)
 
 
 def _fwd(
@@ -127,11 +127,14 @@ def _fwd(
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # Stats ride in a trailing singleton lane dim: block (bq, 1) on
+            # array (t, 1) satisfies Mosaic's (8, 128)-or-full-dim tiling rule
+            # without the official kernel's 128-lane broadcast blowup.
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -166,8 +169,8 @@ def _bwd_dq_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]  # (bq, 1)
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -210,8 +213,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # (bq, 1)
+        delta = delta_ref[0]  # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -250,7 +253,7 @@ def _bwd(
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d**0.5)
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (bh, t)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (bh, t, 1)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk),
@@ -260,8 +263,8 @@ def _bwd(
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),  # lse
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),  # delta
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -277,8 +280,8 @@ def _bwd(
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),  # lse
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),  # delta
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),

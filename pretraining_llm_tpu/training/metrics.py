@@ -43,26 +43,52 @@ class MetricsLogger:
 
 
 class Throughput:
-    """Sliding throughput/MFU meter. Call `tick(tokens)` once per step."""
+    """Throughput/MFU meter over log windows.
+
+    Step dispatch is async (and on some remote platforms `block_until_ready`
+    doesn't synchronize at all), so per-step host timing is meaningless.
+    Instead: `tick(tokens)` cheaply accumulates work each step, and `window()`
+    — called right after a genuine device→host sync (fetching the loss at a
+    log boundary) — converts the wall time since the previous sync into
+    tokens/sec and MFU. `reset_clock()` excludes eval/checkpoint time from the
+    next window.
+    """
 
     def __init__(self, model_cfg: ModelConfig, n_chips: Optional[int] = None) -> None:
         self.flops_per_token = model_cfg.flops_per_token()
         self.n_chips = n_chips or jax.device_count()
         self.peak = device_peak_flops() * self.n_chips
         self._last_time: Optional[float] = None
+        self._tokens = 0
+        self._steps = 0
 
-    def tick(self, tokens: int) -> Dict[str, float]:
+    def tick(self, tokens: int) -> None:
+        self._tokens += tokens
+        self._steps += 1
+
+    def reset_clock(self) -> None:
+        """Restart the window (call after off-path work: eval, checkpoint)."""
+        self._last_time = time.perf_counter()
+        self._tokens = 0
+        self._steps = 0
+
+    def window(self) -> Dict[str, float]:
         now = time.perf_counter()
-        if self._last_time is None:
+        if self._last_time is None or self._steps == 0:
             self._last_time = now
+            self._tokens = 0
+            self._steps = 0
             return {}
         dt = now - self._last_time
-        self._last_time = now
-        tok_per_sec = tokens / dt
+        tok_per_sec = self._tokens / dt
         mfu = tok_per_sec * self.flops_per_token / self.peak
-        return {
-            "step_ms": dt * 1e3,
+        out = {
+            "step_ms": dt / self._steps * 1e3,
             "tokens_per_sec": tok_per_sec,
             "tokens_per_sec_chip": tok_per_sec / self.n_chips,
             "mfu": mfu,
         }
+        self._last_time = now
+        self._tokens = 0
+        self._steps = 0
+        return out

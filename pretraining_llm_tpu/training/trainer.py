@@ -179,21 +179,26 @@ class Trainer:
                 profiler.step(step)
                 batch = self._put(next(self.train_iterator))
                 self.state, metrics = self.step_fn(self.state, batch)
-                tp = self.throughput.tick(tokens_per_step)
+                self.throughput.tick(tokens_per_step)
 
                 if (step + 1) % tcfg.log_interval == 0 or step + 1 == total:
-                    last = {k: float(v) for k, v in metrics.items()}
-                    last.update(tp)
+                    last = {k: float(v) for k, v in metrics.items()}  # device sync
+                    last.update(self.throughput.window())
                     if is_host0:
                         self.logger.log({"step": step + 1, **last})
+                off_path = False
                 if tcfg.eval_interval > 0 and (step + 1) % tcfg.eval_interval == 0:
                     val_loss = self.evaluate()
                     last["val_loss"] = val_loss
+                    off_path = True
                     if is_host0:
                         self.logger.log({"step": step + 1, "val_loss": val_loss})
                 if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
+                    off_path = True
                     if is_host0:
                         self.save(step + 1)
+                if off_path:
+                    self.throughput.reset_clock()  # keep eval/ckpt time out of step_ms
         except Exception as e:
             # Failure recovery (SURVEY §5): persist the last good state before
             # propagating. self.state is the step-(k-1) output and still valid

@@ -32,6 +32,10 @@ def main() -> None:
     parser.add_argument("--top_k", type=int, default=None)
     parser.add_argument("--top_p", type=float, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--tokenizer", default=None,
+        help="override the tokenizer name stored in the checkpoint config",
+    )
     args = parser.parse_args()
 
     text = generate_text(
@@ -42,6 +46,7 @@ def main() -> None:
         top_k=args.top_k,
         top_p=args.top_p,
         seed=args.seed,
+        tokenizer=args.tokenizer,
     )
     print(text)
 
