@@ -68,6 +68,16 @@ class ModelConfig:
     remat: str = "none"  # none | full | dots_saveable
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
+    # Mixture-of-experts MLP (0 = dense). Experts shard over the 'expert' mesh
+    # axis; routing is dense einsum dispatch with a per-expert capacity bound.
+    n_experts: int = 0
+    experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    # Pipeline parallelism: split the layer stack into stages over the 'pipe'
+    # mesh axis, GPipe microbatch schedule via ppermute. 1 = off.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
     def __post_init__(self) -> None:
         if self.activation not in _ACTIVATIONS:
@@ -90,6 +100,28 @@ class ModelConfig:
             raise ValueError("use_output_proj=False requires n_heads*d_head == d_model")
         if self.tie_embeddings and self.lm_head_bias:
             raise ValueError("tie_embeddings is incompatible with lm_head_bias")
+        if self.n_experts:
+            if not 1 <= self.experts_per_token <= self.n_experts:
+                raise ValueError(
+                    f"experts_per_token={self.experts_per_token} must be in "
+                    f"[1, n_experts={self.n_experts}]"
+                )
+            if self.expert_capacity_factor <= 0:
+                raise ValueError("expert_capacity_factor must be positive")
+        if self.pipeline_stages < 1 or self.n_layers % self.pipeline_stages != 0:
+            raise ValueError(
+                f"pipeline_stages={self.pipeline_stages} must divide "
+                f"n_layers={self.n_layers}"
+            )
+        if self.pipeline_microbatches < 1:
+            raise ValueError("pipeline_microbatches must be >= 1")
+        if self.pipeline_stages > 1 and (
+            self.attention_impl in ("ring", "ulysses") or self.sequence_parallel
+        ):
+            raise ValueError(
+                "pipeline parallelism does not compose with sequence/context "
+                "parallelism (ring/ulysses attention or sequence_parallel)"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -120,13 +152,18 @@ class ModelConfig:
         if self.use_output_proj:
             per_block += h * dh * d + d  # wo + bias
         if self.activation == "swiglu":
-            per_block += d * 2 * f + f * d
+            per_expert = d * 2 * f + f * d
             if self.mlp_bias:
-                per_block += 2 * f + d
+                per_expert += 2 * f + d
         else:
-            per_block += d * f + f * d
+            per_expert = d * f + f * d
             if self.mlp_bias:
-                per_block += f + d
+                per_expert += f + d
+        if self.n_experts:
+            per_block += d * self.n_experts  # router
+            per_block += self.n_experts * per_expert
+        else:
+            per_block += per_expert
         n += self.n_layers * per_block
         n += self._norm_params()  # final norm
         if not self.tie_embeddings:
@@ -138,14 +175,36 @@ class ModelConfig:
     def _norm_params(self) -> int:
         return 2 * self.d_model if self.norm == "layernorm" else self.d_model
 
-    def flops_per_token(self) -> int:
-        """Forward+backward training FLOPs per token (6N + attention term).
+    def num_active_params(self) -> int:
+        """Params a single token's forward actually touches.
 
-        Standard approximation used for MFU: 6 * num_params for matmul
-        parameters plus 12 * n_layers * d_model * context_length for the
-        attention score/value matmuls (the O(T^2) term).
+        Equal to num_params for dense models; for MoE only experts_per_token
+        of the n_experts FFNs execute per token, so MFU/throughput math must
+        not count the inactive experts' weights.
         """
-        return 6 * self.num_params() + 12 * self.n_layers * self.d_model * self.context_length
+        n = self.num_params()
+        if self.n_experts:
+            d, f = self.d_model, self.d_ff
+            if self.activation == "swiglu":
+                per_expert = d * 2 * f + f * d + ((2 * f + d) if self.mlp_bias else 0)
+            else:
+                per_expert = d * f + f * d + ((f + d) if self.mlp_bias else 0)
+            inactive = self.n_experts - self.experts_per_token
+            n -= self.n_layers * inactive * per_expert
+        return n
+
+    def flops_per_token(self) -> int:
+        """Forward+backward training FLOPs per token (6N_active + attention).
+
+        Standard approximation used for MFU: 6 * active params for matmul
+        parameters plus 12 * n_layers * d_model * context_length for the
+        attention score/value matmuls (the O(T^2) term). MoE counts only the
+        experts_per_token experts a token executes.
+        """
+        return (
+            6 * self.num_active_params()
+            + 12 * self.n_layers * self.d_model * self.context_length
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -155,7 +214,7 @@ class ModelConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Logical device mesh: (data, fsdp, tensor, seq) axes.
+    """Logical device mesh: (data, fsdp, tensor, seq, expert, pipe) axes.
 
     Replaces the reference's DDP process-group bootstrap
     (`/root/reference/scripts/train_transformer.py:15-29`). One axis per
@@ -167,23 +226,26 @@ class MeshConfig:
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
+    expert: int = 1
+    pipe: int = 1
 
-    axis_names: Tuple[str, ...] = ("data", "fsdp", "tensor", "seq")
+    axis_names: Tuple[str, ...] = ("data", "fsdp", "tensor", "seq", "expert", "pipe")
 
-    def sizes(self, n_devices: int) -> Tuple[int, int, int, int]:
-        fixed = self.fsdp * self.tensor * self.seq
+    def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        fixed = self.fsdp * self.tensor * self.seq * self.expert * self.pipe
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*tensor*seq={fixed}"
+                    f"{n_devices} devices not divisible by fsdp*tensor*seq*expert*pipe={fixed}"
                 )
             data = n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.tensor}x{self.seq} != {n_devices} devices"
+                f"mesh {data}x{self.fsdp}x{self.tensor}x{self.seq}"
+                f"x{self.expert}x{self.pipe} != {n_devices} devices"
             )
-        return (data, self.fsdp, self.tensor, self.seq)
+        return (data, self.fsdp, self.tensor, self.seq, self.expert, self.pipe)
 
 
 # ---------------------------------------------------------------------------
@@ -450,6 +512,26 @@ _register(
         ),
         mesh=MeshConfig(data=-1, fsdp=4),
         train=TrainConfig(batch_size=32, train_steps=200_000, lr=1e-4, eval_interval=1000, eval_iters=250),
+    ),
+)
+
+# Beyond-parity: MoE with expert parallelism (SURVEY §2.2 lists EP as the one
+# strategy the reference leaves open). 8 experts, top-2 routing, experts
+# sharded over the 'expert' mesh axis.
+_register(
+    "moe-8x350m",
+    Config(
+        model=_gpt2_model(
+            context_length=1024,
+            d_model=1024,
+            n_heads=16,
+            n_layers=24,
+            n_experts=8,
+            experts_per_token=2,
+            remat="dots_saveable",
+        ),
+        mesh=MeshConfig(data=-1, expert=4),
+        train=TrainConfig(batch_size=32, lr=3e-4),
     ),
 )
 

@@ -1,0 +1,153 @@
+"""Mixture-of-experts MLP with expert parallelism over the 'expert' mesh axis.
+
+Beyond-parity component: the reference has only a dense MLP
+(`/root/reference/src/models/mlp.py:24-26`); SURVEY §2.2 lists EP as the one
+parallelism strategy left open. This is the TPU-native design:
+
+  - **Dense einsum dispatch** (Switch/Mixtral-style token choice with a static
+    per-expert capacity): routing is expressed as two big einsums against
+    one-hot dispatch/combine tensors, so every shape is static, everything
+    lands on the MXU, and under `pjit` the dispatch contraction over the token
+    dim *is* the all-to-all — XLA inserts the collective from the shardings
+    (tokens sharded over 'data', experts over 'expert'), no hand-written
+    routing tables or ragged buffers.
+  - Top-k gating with renormalized weights, slot-major capacity priority
+    (every token's 1st choice is placed before any token's 2nd choice),
+    dropped tokens fall back to the residual stream (their MoE output is 0).
+  - Switch-style load-balance auxiliary loss in fp32, threaded through the
+    block scan and added to the task loss as `router_aux_coef * aux`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+def init_moe_params(
+    cfg: ModelConfig, key: jax.Array, resid_std: float, dtype: jnp.dtype
+) -> Params:
+    """Per-block MoE params: router (D, E) + stacked expert FFNs (E, ...)."""
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+    k_router, k_w1, k_w2 = jax.random.split(key, 3)
+
+    def normal(k: jax.Array, shape: Tuple[int, ...], s: float = 0.02) -> jax.Array:
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(dtype)
+
+    if cfg.activation == "swiglu":
+        experts: Params = {
+            "w1": normal(k_w1, (e, d, 2, f)),
+            "w2": normal(k_w2, (e, f, d), resid_std),
+        }
+        if cfg.mlp_bias:
+            experts["b1"] = jnp.zeros((e, 2, f), dtype)
+            experts["b2"] = jnp.zeros((e, d), dtype)
+    else:
+        experts = {
+            "w1": normal(k_w1, (e, d, f)),
+            "w2": normal(k_w2, (e, f, d), resid_std),
+        }
+        if cfg.mlp_bias:
+            experts["b1"] = jnp.zeros((e, f), dtype)
+            experts["b2"] = jnp.zeros((e, d), dtype)
+    return {"router": normal(k_router, (d, e)), "experts": experts}
+
+
+def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Static per-expert slot count for a batch of n_tokens."""
+    cap = int(cfg.expert_capacity_factor * cfg.experts_per_token * n_tokens / cfg.n_experts)
+    return max(1, min(cap, n_tokens))
+
+
+def route(
+    router_logits: jax.Array, cfg: ModelConfig, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Token-choice top-k routing with capacity.
+
+    router_logits: (S, E) fp32. Returns (dispatch (S, E, C) 0/1,
+    combine (S, E, C) gate weights, aux scalar load-balance loss).
+    """
+    s, e = router_logits.shape
+    k = cfg.experts_per_token
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (S, E) fp32
+    gate, idx = jax.lax.top_k(probs, k)  # (S, K)
+    gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (S, K, E)
+
+    # Slot-major priority: flatten to (K*S, E) with the choice-rank major so
+    # every token's 1st choice outranks any token's 2nd choice, then a cumsum
+    # assigns each (token, choice) its position within the expert's capacity.
+    slot_major = onehot.transpose(1, 0, 2).reshape(k * s, e)
+    pos = jnp.cumsum(slot_major, axis=0) - slot_major  # positions from 0
+    pos = jnp.sum(pos * slot_major, axis=-1).reshape(k, s).T  # (S, K)
+    keep = (pos < capacity).astype(jnp.float32)  # dropped tokens contribute 0
+    pos_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    pos_onehot = pos_onehot * keep[..., None]
+
+    combine = jnp.einsum("sk,ske,skc->sec", gate * keep, onehot, pos_onehot)
+    dispatch = jnp.einsum("ske,skc->sec", onehot, pos_onehot)
+
+    # Switch-style balance loss: E * sum_e(assignment fraction * mean prob).
+    frac = jnp.mean(jnp.sum(onehot, axis=1), axis=0) / k  # (E,)
+    mean_prob = jnp.mean(probs, axis=0)  # (E,)
+    aux = e * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_mlp(mlp: Params, h: jax.Array, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN on normed input h (B, T, D) -> (output (B, T, D), aux loss)."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    b, t, d = h.shape
+    s = b * t
+    x = h.reshape(s, d)
+
+    router_logits = jnp.einsum(
+        "sd,de->se",
+        x.astype(jnp.float32),
+        mlp["router"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    capacity = expert_capacity(cfg, s)
+    dispatch, combine, aux = route(router_logits, cfg, capacity)
+
+    # Contracting the (data-sharded) token dim against the dispatch mask IS
+    # the all-to-all: XLA lowers it to collectives between the 'data' and
+    # 'expert' mesh axes.
+    xin = jnp.einsum(
+        "sec,sd->ecd", dispatch.astype(cdt), x.astype(cdt), preferred_element_type=jnp.float32
+    ).astype(cdt)
+    xin = constrain(xin, "expert", None, None)
+
+    ex = mlp["experts"]
+    if cfg.activation == "swiglu":
+        gates = jnp.einsum(
+            "ecd,edgf->ecgf", xin, ex["w1"].astype(cdt), preferred_element_type=jnp.float32
+        ).astype(cdt)
+        if "b1" in ex:
+            gates = gates + ex["b1"].astype(cdt)[:, None, :, :]
+        hidden = jax.nn.silu(gates[..., 0, :]) * gates[..., 1, :]
+    else:
+        hidden = jnp.einsum(
+            "ecd,edf->ecf", xin, ex["w1"].astype(cdt), preferred_element_type=jnp.float32
+        ).astype(cdt)
+        if "b1" in ex:
+            hidden = hidden + ex["b1"].astype(cdt)[:, None, :]
+        hidden = jax.nn.relu(hidden) if cfg.activation == "relu" else jax.nn.gelu(
+            hidden, approximate=True
+        )
+    out = jnp.einsum(
+        "ecf,efd->ecd", hidden, ex["w2"].astype(cdt), preferred_element_type=jnp.float32
+    ).astype(cdt)
+    if "b2" in ex:
+        out = out + ex["b2"].astype(cdt)[:, None, :]
+    out = constrain(out, "expert", None, None)
+
+    y = jnp.einsum("sec,ecd->sd", combine.astype(cdt), out, preferred_element_type=jnp.float32)
+    return y.astype(h.dtype).reshape(b, t, d), aux

@@ -32,9 +32,9 @@ import jax
 import jax.numpy as jnp
 
 from pretraining_llm_tpu.config import ModelConfig
-from pretraining_llm_tpu.models import layers
+from pretraining_llm_tpu.models import layers, moe
 from pretraining_llm_tpu.ops.attention import multihead_attention
-from pretraining_llm_tpu.parallel.sharding import constrain
+from pretraining_llm_tpu.parallel.sharding import constrain, current_mesh
 
 Params = Dict[str, Any]
 KVCache = Dict[str, jax.Array]  # {'k','v'}: (L, B, Tmax, H, Dh)
@@ -76,7 +76,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         if cfg.use_output_proj:
             attn["wo"] = normal(ks[1], (h, dh, d), resid_std)
             attn["bo"] = jnp.zeros((d,), dtype)
-        if cfg.activation == "swiglu":
+        if cfg.n_experts:
+            mlp: Params = moe.init_moe_params(cfg, ks[2], resid_std, dtype)
+        elif cfg.activation == "swiglu":
             mlp: Params = {"w1": normal(ks[2], (d, 2, f)), "w2": normal(ks[3], (f, d), resid_std)}
             if cfg.mlp_bias:
                 mlp["b1"] = jnp.zeros((2, f), dtype)
@@ -185,11 +187,14 @@ def _attention_block(
     return x + out.astype(x.dtype), new_kv
 
 
-def _mlp_block(blk: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Pre-LN MLP sub-block: x + mlp(ln2(x))."""
+def _mlp_block(blk: Params, x: jax.Array, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Pre-LN MLP sub-block: x + mlp(ln2(x)). Returns (x, router aux loss)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     h = layers.apply_norm(cfg.norm, blk["ln2"], x, cfg.norm_eps).astype(cdt)
     mlp = blk["mlp"]
+    if cfg.n_experts:
+        out, aux = moe.moe_mlp(mlp, h, cfg)
+        return x + out.astype(x.dtype), aux
     if cfg.activation == "swiglu":
         gates = jnp.einsum(
             "btd,dcf->bctf", h, mlp["w1"].astype(cdt), preferred_element_type=jnp.float32
@@ -209,7 +214,7 @@ def _mlp_block(blk: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     ).astype(cdt)
     if "b2" in mlp:
         out = out + mlp["b2"].astype(cdt)
-    return x + out.astype(x.dtype)
+    return x + out.astype(x.dtype), jnp.zeros((), jnp.float32)
 
 
 def _block(
@@ -220,16 +225,16 @@ def _block(
     positions: jax.Array,
     kv: Optional[Tuple[jax.Array, jax.Array]],
     cache_index: Optional[jax.Array],
-) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
+) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]], jax.Array]:
     x, new_kv = _attention_block(blk, x, cfg, rope, positions, kv, cache_index)
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
-    x = _mlp_block(blk, x, cfg)
+    x, aux = _mlp_block(blk, x, cfg)
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
-    return x, new_kv
+    return x, new_kv, aux
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +251,7 @@ def forward(
     kv_cache: Optional[KVCache] = None,
     cache_index: Optional[jax.Array] = None,
     return_hidden: bool = False,
+    return_aux: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
@@ -257,6 +263,9 @@ def forward(
     {'block_outputs': (L, B, T, D), 'final_hidden': (B, T, D)} — the
     feature-extraction hook replacing the reference's bespoke
     ``forward_embedding`` methods (transformer.py:80-94, SURVEY §A Q3).
+
+    ``return_aux=True`` additionally returns the summed MoE router
+    load-balance loss (zero for dense models).
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t = tokens.shape
@@ -273,14 +282,14 @@ def forward(
     x = constrain(x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None)
 
     def scan_body(carry, layer_inputs):
-        x = carry
+        x, aux_sum = carry
         if kv_cache is None:
             blk = layer_inputs
-            x, _ = _block(blk, x, cfg, rope, positions, None, None)
-            return x, (x if return_hidden else None)
+            x, _, aux = _block(blk, x, cfg, rope, positions, None, None)
+            return (x, aux_sum + aux), (x if return_hidden else None)
         blk, ck, cv = layer_inputs
-        x, new_kv = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
-        return x, new_kv
+        x, new_kv, aux = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
+        return (x, aux_sum + aux), new_kv
 
     body = scan_body
     if cfg.remat == "full":
@@ -290,13 +299,36 @@ def forward(
             scan_body, policy=jax.checkpoint_policies.dots_saveable
         )
 
+    mesh = current_mesh()
+    use_pipeline = (
+        kv_cache is None
+        and cfg.pipeline_stages > 1
+        and mesh is not None
+        and mesh.shape.get("pipe", 1) > 1
+    )
+
     block_outputs = None
-    if kv_cache is None:
-        x, block_outputs = jax.lax.scan(body, x, params["blocks"])
+    aux0 = jnp.zeros((), jnp.float32)
+    if use_pipeline:
+        if return_hidden:
+            raise ValueError("return_hidden is not supported with pipeline parallelism")
+        from pretraining_llm_tpu.parallel import pipeline
+
+        def pipe_block(blk, h):
+            h, _, aux = _block(blk, h, cfg, rope, positions, None, None)
+            return h, aux
+
+        x, aux_total = pipeline.pipeline_apply(
+            params["blocks"], x, mesh, pipe_block,
+            n_micro=cfg.pipeline_microbatches, remat=cfg.remat,
+        )
+        new_cache = None
+    elif kv_cache is None:
+        (x, aux_total), block_outputs = jax.lax.scan(body, (x, aux0), params["blocks"])
         new_cache = None
     else:
-        x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"])
+        (x, aux_total), (new_k, new_v) = jax.lax.scan(
+            body, (x, aux0), (params["blocks"], kv_cache["k"], kv_cache["v"])
         )
         new_cache = {"k": new_k, "v": new_v}
 
@@ -310,20 +342,32 @@ def forward(
     )
     if not cfg.tie_embeddings and "bias" in params.get("lm_head", {}):
         logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+    extras: Tuple[Any, ...] = ()
     if return_hidden:
-        return logits, new_cache, {"block_outputs": block_outputs, "final_hidden": x}
+        extras += ({"block_outputs": block_outputs, "final_hidden": x},)
+    if return_aux:
+        extras += (aux_total,)
+    if extras:
+        return (logits, new_cache) + extras
     return logits, new_cache
 
 
 def loss_fn(
     params: Params, tokens: jax.Array, targets: jax.Array, cfg: ModelConfig
 ) -> jax.Array:
-    """Mean next-token cross-entropy in fp32 (reference: transformer.py:73-77)."""
-    logits, _ = forward(params, tokens, cfg)
+    """Mean next-token cross-entropy in fp32 (reference: transformer.py:73-77).
+
+    For MoE models the Switch-style router load-balance loss is added with
+    weight ``cfg.router_aux_coef``.
+    """
+    logits, _, aux = forward(params, tokens, cfg, return_aux=True)
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     label_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - label_logit)
+    loss = jnp.mean(logz - label_logit)
+    if cfg.n_experts:
+        loss = loss + cfg.router_aux_coef * aux
+    return loss
 
 
 def make_kv_cache(

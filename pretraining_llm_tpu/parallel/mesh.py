@@ -13,6 +13,8 @@ Axes (sized by `MeshConfig`):
   fsdp   — data parallelism + param/optimizer-state sharding (ZeRO-3 style)
   tensor — Megatron-style tensor parallelism (heads / mlp hidden / vocab)
   seq    — sequence/context parallelism (ring attention, Megatron-SP)
+  expert — expert parallelism (MoE expert FFNs sharded one-per-group)
+  pipe   — pipeline parallelism (layer stages, microbatch schedule)
 """
 
 from __future__ import annotations
@@ -62,7 +64,6 @@ def build_mesh(
 
 
 def single_device_mesh() -> Mesh:
-    """A 1x1x1x1 mesh on the first device — for tests and CPU smoke runs."""
-    return Mesh(
-        np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), ("data", "fsdp", "tensor", "seq")
-    )
+    """An all-ones mesh on the first device — for tests and CPU smoke runs."""
+    names = MeshConfig().axis_names
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1,) * len(names)), names)

@@ -1,0 +1,146 @@
+"""Pipeline parallelism: layer stages over the 'pipe' mesh axis.
+
+SURVEY §2.2 lists PP as absent from the reference (whose model lives on one
+device, train_transformer.py:116) and asks the framework to leave a mesh axis
+for it. This is the TPU-native design — no per-stage processes, no send/recv
+threads, no schedule executor; the whole pipeline is ONE jitted SPMD program:
+
+  - The stacked block params (leading n_layers dim, scanned by the model)
+    reshard so each pipe rank holds a contiguous slice of layers
+    (`PartitionSpec('pipe', ...)` on the stacked dim — stage assignment is a
+    sharding decision, not a code structure).
+  - A GPipe schedule runs inside `jax.shard_map`: each tick, stage 0 injects
+    the next microbatch, every stage applies its local layers, and activations
+    hop to the next stage with a single `jax.lax.ppermute` (one ICI neighbor
+    hop). n_micro + n_stages - 1 ticks drain the pipe.
+  - The backward pass needs no schedule of its own: `jax.grad` transposes the
+    whole loop (ppermute transposes to the reverse hop), so the 1F1B-style
+    reverse traffic falls out of autodiff.
+  - Embeddings / final norm / lm-head stay outside the region under plain
+    GSPMD, replicated over 'pipe' (they are a tiny fraction of compute).
+
+Composes with the 'data'/'fsdp' batch axes (batch stays sharded inside the
+region). Within a stage, weights are replicated over fsdp/tensor — PP here is
+an alternative to FSDP/TP for the layer stack, as in the dryrun configs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+BlockFn = Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]
+
+
+def pipeline_apply(
+    blocks: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    block_fn: BlockFn,
+    *,
+    n_micro: int,
+    remat: str = "none",
+    pipe_axis: str = "pipe",
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the stacked layer stack as a pipeline.
+
+    blocks: stacked block params, leading dim n_layers (sharded over 'pipe').
+    x: (B, T, D) embedded activations; B divides into n_micro microbatches.
+    block_fn: (block_params, x) -> (x, aux) for ONE layer.
+    Returns (y (B, T, D), aux_sum) — aux summed over layers, averaged over
+    microbatches (matching the non-pipelined scan semantics).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    b = x.shape[0]
+    # The PER-SHARD batch must divide into microbatches (the reshape happens
+    # inside the manual region, after the batch axes split it).
+    batch_shards = 1
+    for ax in batch_axes:
+        batch_shards *= mesh.shape.get(ax, 1)
+    if b % batch_shards != 0 or (b // batch_shards) % n_micro != 0:
+        raise ValueError(
+            f"global batch {b} over {batch_shards} data shards gives a local "
+            f"batch of {b // batch_shards if b % batch_shards == 0 else b / batch_shards}, "
+            f"not divisible by pipeline_microbatches={n_micro}"
+        )
+
+    body = block_fn
+    if remat == "full":
+        body = jax.checkpoint(block_fn)
+    elif remat == "dots_saveable":
+        body = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.dots_saveable)
+
+    def local(blocks_local: Any, x_local: jax.Array):
+        # blocks_local: leading dim n_layers/n_stages; x_local: (b_local, T, D)
+        from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+        rank = jax.lax.axis_index(pipe_axis)
+        bl = x_local.shape[0]
+        mb = bl // n_micro
+        mbs = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+
+        def apply_stage(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+            def layer(carry, blk):
+                h, aux = carry
+                h, aux_i = body(blk, h)
+                return (h, aux + aux_i), None
+
+            (y, aux), _ = jax.lax.scan(layer, (a, jnp.zeros((), jnp.float32)), blocks_local)
+            return y, aux
+
+        # Stage s sends to s+1; stage 0 receives zeros (replaced by injection).
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            recv, out_buf, aux_sum = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            a = jnp.where(rank == 0, inject, recv)
+            y, aux = apply_stage(a)
+            # This rank computed microbatch (t - rank): only count real work.
+            valid = ((t - rank) >= 0) & ((t - rank) < n_micro)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+            # Last stage banks its finished microbatch.
+            slot = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(out_buf, y, slot, 0)
+            out_buf = jnp.where((rank == n_stages - 1) & (t >= n_stages - 1), banked, out_buf)
+            recv = jax.lax.ppermute(y, pipe_axis, perm)
+            return (recv, out_buf, aux_sum), None
+
+        # GSPMD sharding constraints are meaningless inside the manual region.
+        with activation_mesh(None):
+            init = (
+                jnp.zeros((mb, *x_local.shape[1:]), x_local.dtype),
+                jnp.zeros_like(mbs),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, out_buf, aux_sum), _ = jax.lax.scan(
+                tick, init, jnp.arange(n_micro + n_stages - 1)
+            )
+
+        out = out_buf.reshape(bl, *x_local.shape[1:])
+        # Broadcast the last stage's result (and its aux) to every pipe rank.
+        is_last = (rank == n_stages - 1).astype(out.dtype)
+        out = jax.lax.psum(out * is_last, pipe_axis)
+        # Aux statistics are per (data shard x microbatch) group; average over
+        # microbatches AND the batch axes so the scalar is well-defined
+        # (replicated) everywhere.
+        aux_total = jax.lax.psum(aux_sum, pipe_axis) / n_micro
+        aux_total = jax.lax.pmean(aux_total, batch_axes)
+        return out, aux_total
+
+    blocks_spec = jax.tree.map(lambda _: P(pipe_axis), blocks)
+    x_spec = P(batch_axes)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(blocks_spec, x_spec),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )(blocks, x)

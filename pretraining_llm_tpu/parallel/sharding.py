@@ -39,19 +39,42 @@ def _path_names(path: Tuple[Any, ...]) -> Tuple[str, ...]:
     return tuple(names)
 
 
-def param_pspec(path_names: Tuple[str, ...], ndim: int) -> P:
+def param_pspec(path_names: Tuple[str, ...], ndim: int, pipeline: bool = False) -> P:
     """PartitionSpec for one parameter, keyed on its pytree path.
 
     Parameters under 'blocks' are stacked with a leading n_layers dim (scanned
-    by the model), which is never sharded — specs for those get a leading None.
+    by the model). Without pipelining that dim is never sharded (leading None);
+    with ``pipeline=True`` it shards over 'pipe' (stage assignment IS the
+    sharding) and the weight dims replicate — inside the manual pipeline
+    region each stage computes on whole-weight shards.
     """
     name = path_names[-1]
     parent = path_names[-2] if len(path_names) >= 2 else ""
     in_blocks = "blocks" in path_names
 
+    if pipeline and in_blocks:
+        return P("pipe", *([None] * (ndim - 1)))
+
     def blk(*spec: Optional[str]) -> P:
         return P(None, *spec) if in_blocks else P(*spec)
 
+    if "experts" in path_names:
+        # MoE expert FFNs: leading E dim over 'expert', matrices TP+FSDP like
+        # their dense counterparts (column-parallel w1, row-parallel w2).
+        if name == "w1":  # (E, D, F) or (E, D, 2, F) swiglu
+            if ndim - (1 if in_blocks else 0) == 4:
+                return blk("expert", "fsdp", None, "tensor")
+            return blk("expert", "fsdp", "tensor")
+        if name == "b1":  # (E, F) or (E, 2, F)
+            if ndim - (1 if in_blocks else 0) == 3:
+                return blk("expert", None, "tensor")
+            return blk("expert", "tensor")
+        if name == "w2":  # (E, F, D)
+            return blk("expert", "tensor", "fsdp")
+        if name == "b2":  # (E, D)
+            return blk("expert", None)
+    if name == "router":  # (D, E)
+        return blk("fsdp", None)
     if name == "embedding":
         if parent == "tok_embed":
             return P("tensor", "fsdp")  # (V, D): vocab TP, dim FSDP
@@ -88,10 +111,13 @@ def param_pspec(path_names: Tuple[str, ...], ndim: int) -> P:
     return P(*([None] * ndim))
 
 
-def param_pspec_tree(params: Any) -> Any:
+def param_pspec_tree(params: Any, pipeline: bool = False) -> Any:
     """Map a params (or optimizer-moment) pytree to a PartitionSpec pytree."""
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: param_pspec(_path_names(path), getattr(leaf, "ndim", 0)), params
+        lambda path, leaf: param_pspec(
+            _path_names(path), getattr(leaf, "ndim", 0), pipeline
+        ),
+        params,
     )
 
 

@@ -44,22 +44,31 @@ def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
     return {"params": params, "opt": opt.adamw_init(params), "step": jnp.zeros((), jnp.int32)}
 
 
-def state_pspec_tree(state: TrainState) -> Any:
+def state_pspec_tree(state: TrainState, pipeline: bool = False) -> Any:
     """PartitionSpecs for the full train state (moments mirror params)."""
-    pspecs = param_pspec_tree(state["params"])
+    pspecs = param_pspec_tree(state["params"], pipeline)
     return {
         "params": pspecs,
         "opt": {
-            "mu": param_pspec_tree(state["opt"]["mu"]),
-            "nu": param_pspec_tree(state["opt"]["nu"]),
+            "mu": param_pspec_tree(state["opt"]["mu"], pipeline),
+            "nu": param_pspec_tree(state["opt"]["nu"], pipeline),
             "count": P(),
         },
         "step": P(),
     }
 
 
-def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
-    shardings = named_sharding_tree(mesh, state_pspec_tree(state))
+def _is_pipelined(cfg: Config, mesh: Optional[Mesh]) -> bool:
+    return (
+        cfg.model.pipeline_stages > 1
+        and mesh is not None
+        and mesh.shape.get("pipe", 1) > 1
+    )
+
+
+def shard_train_state(state: TrainState, mesh: Mesh, cfg: Optional[Config] = None) -> TrainState:
+    pipeline = cfg is not None and _is_pipelined(cfg, mesh)
+    shardings = named_sharding_tree(mesh, state_pspec_tree(state, pipeline))
     return jax.device_put(state, shardings)
 
 
@@ -126,11 +135,13 @@ def build_train_step(
     batch_sharding = NamedSharding(mesh, batch_pspec(model_cfg.sequence_parallel))
     compiled_cache: Dict[Any, Any] = {}
 
+    pipelined = _is_pipelined(cfg, mesh)
+
     def wrapper(state, batch):
         key = jax.tree.structure(state)
         fn = compiled_cache.get(key)
         if fn is None:
-            state_shardings = named_sharding_tree(mesh, state_pspec_tree(state))
+            state_shardings = named_sharding_tree(mesh, state_pspec_tree(state, pipelined))
             fn = jax.jit(
                 traced,
                 in_shardings=(state_shardings, (batch_sharding, batch_sharding)),

@@ -51,7 +51,14 @@ class Trainer:
 
             enable_nan_checks()
         needs_mesh = jax.device_count() > 1 or any(
-            s > 1 for s in (config.mesh.fsdp, config.mesh.tensor, config.mesh.seq)
+            s > 1
+            for s in (
+                config.mesh.fsdp,
+                config.mesh.tensor,
+                config.mesh.seq,
+                config.mesh.expert,
+                config.mesh.pipe,
+            )
         )
         self.mesh = mesh if mesh is not None else (build_mesh(config.mesh) if needs_mesh else None)
         self.logger = logger or MetricsLogger(config.train.metrics_path)
@@ -100,7 +107,7 @@ class Trainer:
         else:
             state = ts.init_train_state(config, jax.random.key(tcfg.seed))
         if self.mesh is not None:
-            state = ts.shard_train_state(state, self.mesh)
+            state = ts.shard_train_state(state, self.mesh, config)
         else:
             state = jax.device_put(state)
         self.state = state

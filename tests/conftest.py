@@ -24,15 +24,25 @@ import pytest
 from jax.sharding import Mesh
 
 
+AXES = ("data", "fsdp", "tensor", "seq", "expert", "pipe")
+
+
 @pytest.fixture(scope="session")
 def mesh8() -> Mesh:
-    """2 data x 2 fsdp x 2 tensor x 1 seq mesh over the 8 virtual devices."""
-    devs = np.asarray(jax.devices()).reshape(2, 2, 2, 1)
-    return Mesh(devs, ("data", "fsdp", "tensor", "seq"))
+    """2 data x 2 fsdp x 2 tensor mesh over the 8 virtual devices."""
+    devs = np.asarray(jax.devices()).reshape(2, 2, 2, 1, 1, 1)
+    return Mesh(devs, AXES)
 
 
 @pytest.fixture(scope="session")
 def mesh_seq4() -> Mesh:
-    """2 data x 1 x 1 x 4 seq mesh for ring-attention tests."""
-    devs = np.asarray(jax.devices()).reshape(2, 1, 1, 4)
-    return Mesh(devs, ("data", "fsdp", "tensor", "seq"))
+    """2 data x 4 seq mesh for ring-attention tests."""
+    devs = np.asarray(jax.devices()).reshape(2, 1, 1, 4, 1, 1)
+    return Mesh(devs, AXES)
+
+
+@pytest.fixture(scope="session")
+def mesh_exp4() -> Mesh:
+    """2 data x 4 expert mesh for MoE expert-parallel tests."""
+    devs = np.asarray(jax.devices()).reshape(2, 1, 1, 1, 4, 1)
+    return Mesh(devs, AXES)

@@ -59,10 +59,19 @@ def test_validation_rejects_bad_values():
 
 
 def test_mesh_sizes():
-    assert MeshConfig(data=-1, fsdp=2).sizes(8) == (4, 2, 1, 1)
-    assert MeshConfig(data=2, fsdp=2, tensor=2).sizes(8) == (2, 2, 2, 1)
+    assert MeshConfig(data=-1, fsdp=2).sizes(8) == (4, 2, 1, 1, 1, 1)
+    assert MeshConfig(data=2, fsdp=2, tensor=2).sizes(8) == (2, 2, 2, 1, 1, 1)
+    assert MeshConfig(data=-1, expert=4).sizes(8) == (2, 1, 1, 1, 4, 1)
     with pytest.raises(ValueError):
         MeshConfig(data=3).sizes(8)
+
+
+def test_moe_validation():
+    with pytest.raises(ValueError):
+        ModelConfig(n_experts=4, experts_per_token=5)
+    with pytest.raises(ValueError):
+        ModelConfig(n_experts=4, expert_capacity_factor=0.0)
+    ModelConfig(n_experts=4, experts_per_token=2)  # valid
 
 
 def test_json_roundtrip():
