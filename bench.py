@@ -46,6 +46,9 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
+    parser.add_argument(
+        "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn"]
+    )
     args = parser.parse_args()
 
     cfg = get_preset(args.preset)
@@ -54,9 +57,13 @@ def main() -> None:
         model = dataclasses.replace(model, attention_impl=args.attention)
     elif model.attention_impl == "ring":
         model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
-    # Memory-conscious defaults for a single chip: remat the blocks.
-    if model.remat == "none":
-        model = dataclasses.replace(model, remat="dots_saveable")
+    if args.remat:
+        model = dataclasses.replace(model, remat=args.remat)
+    elif model.remat == "none":
+        # Measured faster AND leaner on v5e: saving fewer activations cuts
+        # HBM traffic by more than the recompute costs (full remat beats
+        # dots_saveable 129.8ms vs 132.8ms at gpt2-124m/batch 12).
+        model = dataclasses.replace(model, remat="full")
     batch = args.batch or cfg.train.batch_size
     if args.quick:
         args.steps, args.warmup, batch = 5, 2, min(batch, 4)

@@ -25,7 +25,7 @@ _ACTIVATIONS = ("relu", "gelu", "swiglu")
 _NORMS = ("layernorm", "rmsnorm")
 _POS_EMBEDS = ("learned", "rope")
 _ATTN_IMPLS = ("naive", "flash", "ring", "ulysses")
-_REMAT_POLICIES = ("none", "full", "dots_saveable")
+_REMAT_POLICIES = ("none", "full", "dots_saveable", "save_attn")
 
 
 @dataclass(frozen=True)
@@ -65,7 +65,10 @@ class ModelConfig:
     flash_block_q: int = 0
     flash_block_kv: int = 0
     # Rematerialization policy applied to each scanned block
-    remat: str = "none"  # none | full | dots_saveable
+    remat: str = "none"  # none | full | dots_saveable | save_attn
+    # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
+    # fuse across layer boundaries at the cost of compile time.
+    scan_unroll: int = 1
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
     # Mixture-of-experts MLP (0 = dense). Experts shard over the 'expert' mesh
@@ -151,14 +154,7 @@ class ModelConfig:
             per_block += 3 * h * dh
         if self.use_output_proj:
             per_block += h * dh * d + d  # wo + bias
-        if self.activation == "swiglu":
-            per_expert = d * 2 * f + f * d
-            if self.mlp_bias:
-                per_expert += 2 * f + d
-        else:
-            per_expert = d * f + f * d
-            if self.mlp_bias:
-                per_expert += f + d
+        per_expert = self._per_expert_params()
         if self.n_experts:
             per_block += d * self.n_experts  # router
             per_block += self.n_experts * per_expert
@@ -175,6 +171,13 @@ class ModelConfig:
     def _norm_params(self) -> int:
         return 2 * self.d_model if self.norm == "layernorm" else self.d_model
 
+    def _per_expert_params(self) -> int:
+        """One FFN's parameter count (the dense MLP, or one MoE expert)."""
+        d, f = self.d_model, self.d_ff
+        if self.activation == "swiglu":
+            return d * 2 * f + f * d + ((2 * f + d) if self.mlp_bias else 0)
+        return d * f + f * d + ((f + d) if self.mlp_bias else 0)
+
     def num_active_params(self) -> int:
         """Params a single token's forward actually touches.
 
@@ -184,13 +187,8 @@ class ModelConfig:
         """
         n = self.num_params()
         if self.n_experts:
-            d, f = self.d_model, self.d_ff
-            if self.activation == "swiglu":
-                per_expert = d * 2 * f + f * d + ((2 * f + d) if self.mlp_bias else 0)
-            else:
-                per_expert = d * f + f * d + ((f + d) if self.mlp_bias else 0)
             inactive = self.n_experts - self.experts_per_token
-            n -= self.n_layers * inactive * per_expert
+            n -= self.n_layers * inactive * self._per_expert_params()
         return n
 
     def flops_per_token(self) -> int:
@@ -423,7 +421,10 @@ def _register(name: str, cfg: Config) -> None:
 _register(
     "gpt2-124m",
     Config(
-        model=_gpt2_model(context_length=1024, d_model=768, n_heads=12, n_layers=12),
+        model=_gpt2_model(
+            context_length=1024, d_model=768, n_heads=12, n_layers=12,
+            attention_impl="flash",
+        ),
         mesh=MeshConfig(),
         train=TrainConfig(batch_size=12, train_steps=5000, lr=6e-4, eval_interval=250, eval_iters=20),
     ),

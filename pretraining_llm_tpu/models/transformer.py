@@ -175,6 +175,12 @@ def _attention_block(
             block_kv=cfg.flash_block_kv,
         )
 
+    # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,
+    # expensive-to-recompute) attention output, recompute everything else.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_out")
+
     if cfg.use_output_proj:
         out = jnp.einsum(
             "bthn,hnd->btd", out, blk["attn"]["wo"].astype(cdt),
@@ -252,6 +258,7 @@ def forward(
     cache_index: Optional[jax.Array] = None,
     return_hidden: bool = False,
     return_aux: bool = False,
+    return_pre_logits: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
@@ -298,6 +305,11 @@ def forward(
         body = jax.checkpoint(
             scan_body, policy=jax.checkpoint_policies.dots_saveable
         )
+    elif cfg.remat == "save_attn":
+        body = jax.checkpoint(
+            scan_body,
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
 
     mesh = current_mesh()
     use_pipeline = (
@@ -324,24 +336,32 @@ def forward(
         )
         new_cache = None
     elif kv_cache is None:
-        (x, aux_total), block_outputs = jax.lax.scan(body, (x, aux0), params["blocks"])
+        (x, aux_total), block_outputs = jax.lax.scan(
+            body, (x, aux0), params["blocks"], unroll=cfg.scan_unroll
+        )
         new_cache = None
     else:
         (x, aux_total), (new_k, new_v) = jax.lax.scan(
-            body, (x, aux0), (params["blocks"], kv_cache["k"], kv_cache["v"])
+            body, (x, aux0), (params["blocks"], kv_cache["k"], kv_cache["v"]),
+            unroll=cfg.scan_unroll,
         )
         new_cache = {"k": new_k, "v": new_v}
 
     x = layers.apply_norm(cfg.norm, params["final_norm"], x, cfg.norm_eps)
-    if cfg.tie_embeddings:
-        w_out = params["tok_embed"]["embedding"].T
+    if return_pre_logits:
+        # Loss path: the chunked-CE head computes logits itself (see
+        # _chunked_ce); hand back the final-norm hidden states.
+        logits = x
     else:
-        w_out = params["lm_head"]["kernel"]
-    logits = jnp.einsum(
-        "btd,dv->btv", x.astype(cdt), w_out.astype(cdt), preferred_element_type=jnp.float32
-    )
-    if not cfg.tie_embeddings and "bias" in params.get("lm_head", {}):
-        logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+        if cfg.tie_embeddings:
+            w_out = params["tok_embed"]["embedding"].T
+        else:
+            w_out = params["lm_head"]["kernel"]
+        logits = jnp.einsum(
+            "btd,dv->btv", x.astype(cdt), w_out.astype(cdt), preferred_element_type=jnp.float32
+        )
+        if not cfg.tie_embeddings and "bias" in params.get("lm_head", {}):
+            logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
     extras: Tuple[Any, ...] = ()
     if return_hidden:
         extras += ({"block_outputs": block_outputs, "final_hidden": x},)
@@ -352,20 +372,83 @@ def forward(
     return logits, new_cache
 
 
+def _chunked_ce(
+    hidden: jax.Array,
+    w_out: jax.Array,
+    bias: Optional[jax.Array],
+    targets: jax.Array,
+    cfg: ModelConfig,
+) -> jax.Array:
+    """Mean cross-entropy without materializing the full (B*T, V) logits.
+
+    The fp32 logits for GPT-2-sized vocabs dwarf every other activation
+    (B=12, T=1024, V=50304 -> 2.5 GB); computing them whole, saving them for
+    backward, and re-reading them is pure HBM traffic. Instead scan over token
+    chunks under jax.checkpoint: each chunk's logits live only transiently,
+    and the backward recomputes them chunk-by-chunk (one extra small matmul
+    per chunk for a ~3x cut in head memory traffic).
+    """
+    cdt = jnp.dtype(cfg.compute_dtype)
+    b, t, d = hidden.shape
+    s = b * t
+    # Chunk only when the fp32 logits buffer is big enough to matter (XLA
+    # already fuses the small-head case well — measured neutral-to-slower to
+    # chunk at GPT-2 batch sizes). Target <= ~512 MB per chunk.
+    logits_bytes = s * cfg.vocab_size * 4
+    want = max(1, -(-logits_bytes // (512 * 1024 * 1024)))
+    n_chunks = 1
+    if want > 1:
+        for cand in range(want, 4 * want + 1):
+            if s % cand == 0 and s // cand >= 512:
+                n_chunks = cand
+                break
+    xs = hidden.reshape(n_chunks, s // n_chunks, d)
+    ts_ = targets.reshape(n_chunks, s // n_chunks)
+
+    def chunk(carry, inp):
+        xc, tc = inp
+        logits = jnp.einsum(
+            "sd,dv->sv", xc.astype(cdt), w_out.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        label_logit = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum(logz - label_logit), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32), (xs, ts_))
+    return total / s
+
+
 def loss_fn(
-    params: Params, tokens: jax.Array, targets: jax.Array, cfg: ModelConfig
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: ModelConfig,
+    *,
+    include_aux: bool = True,
 ) -> jax.Array:
     """Mean next-token cross-entropy in fp32 (reference: transformer.py:73-77).
 
-    For MoE models the Switch-style router load-balance loss is added with
-    weight ``cfg.router_aux_coef``.
+    Computed via the chunked head (see _chunked_ce) — numerically identical
+    to logsumexp over full logits, but O(1/n_chunks) head memory. For MoE
+    models the Switch-style router load-balance loss is added with weight
+    ``cfg.router_aux_coef`` when ``include_aux`` (training objective); eval
+    passes include_aux=False so reported val_loss stays pure cross-entropy,
+    comparable across dense and MoE models.
     """
-    logits, _, aux = forward(params, tokens, cfg, return_aux=True)
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    label_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(logz - label_logit)
-    if cfg.n_experts:
+    hidden, _, aux = forward(
+        params, tokens, cfg, return_aux=True, return_pre_logits=True
+    )
+    if cfg.tie_embeddings:
+        w_out = params["tok_embed"]["embedding"].T
+        bias = None
+    else:
+        w_out = params["lm_head"]["kernel"]
+        bias = params.get("lm_head", {}).get("bias")
+    loss = _chunked_ce(hidden, w_out, bias, targets, cfg)
+    if cfg.n_experts and include_aux:
         loss = loss + cfg.router_aux_coef * aux
     return loss
 

@@ -74,6 +74,10 @@ def pipeline_apply(
         body = jax.checkpoint(block_fn)
     elif remat == "dots_saveable":
         body = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.dots_saveable)
+    elif remat == "save_attn":
+        body = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.save_only_these_names("attn_out")
+        )
 
     def local(blocks_local: Any, x_local: jax.Array):
         # blocks_local: leading dim n_layers/n_stages; x_local: (b_local, T, D)

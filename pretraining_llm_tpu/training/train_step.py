@@ -162,6 +162,7 @@ def build_eval_step(
     def eval_fn(state: TrainState, batch):
         x, y = batch
         with activation_mesh(mesh):
-            return transformer.loss_fn(state["params"], x, y, model_cfg)
+            # Pure CE (no MoE router aux): val_loss comparable across models.
+            return transformer.loss_fn(state["params"], x, y, model_cfg, include_aux=False)
 
     return jax.jit(eval_fn)

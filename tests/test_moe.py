@@ -11,11 +11,9 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from pretraining_llm_tpu.config import ModelConfig, get_preset
 from pretraining_llm_tpu.models import moe, transformer
-from pretraining_llm_tpu.parallel.sharding import activation_mesh
 from pretraining_llm_tpu.training import train_step as ts
 
 
