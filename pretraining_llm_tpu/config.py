@@ -45,6 +45,10 @@ class ModelConfig:
     n_heads: int = 12
     n_layers: int = 12
     d_head: Optional[int] = None  # defaults to d_model // n_heads
+    # Grouped-query attention: number of KV heads (None = n_heads, i.e. MHA;
+    # 1 = MQA). Shrinks KV-cache memory and KV projection params by
+    # n_heads/n_kv_heads.
+    n_kv_heads: Optional[int] = None
     mlp_ratio: float = 4.0
     activation: str = "gelu"  # relu | gelu | swiglu
     norm: str = "layernorm"  # layernorm | rmsnorm
@@ -99,6 +103,13 @@ class ModelConfig:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by n_heads={self.n_heads}; set d_head"
             )
+        if self.n_kv_heads is not None and (
+            not 1 <= self.n_kv_heads <= self.n_heads
+            or self.n_heads % self.n_kv_heads != 0
+        ):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must divide n_heads={self.n_heads}"
+            )
         if not self.use_output_proj and self.head_dim * self.n_heads != self.d_model:
             raise ValueError("use_output_proj=False requires n_heads*d_head == d_model")
         if self.tie_embeddings and self.lm_head_bias:
@@ -131,6 +142,10 @@ class ModelConfig:
         return self.d_head if self.d_head is not None else self.d_model // self.n_heads
 
     @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
     def d_ff(self) -> int:
         return int(self.mlp_ratio * self.d_model)
 
@@ -147,11 +162,12 @@ class ModelConfig:
         n = v * d  # token embedding
         if self.pos_embed == "learned":
             n += t * d
+        g = self.kv_heads
         per_block = 0
         per_block += 2 * self._norm_params()  # ln1, ln2
-        per_block += 3 * d * h * dh  # wqkv
+        per_block += d * h * dh + 2 * d * g * dh  # wqkv (or wq + wkv for GQA)
         if self.qkv_bias:
-            per_block += 3 * h * dh
+            per_block += h * dh + 2 * g * dh
         if self.use_output_proj:
             per_block += h * dh * d + d  # wo + bias
         per_expert = self._per_expert_params()
@@ -513,6 +529,27 @@ _register(
         ),
         mesh=MeshConfig(data=-1, fsdp=4),
         train=TrainConfig(batch_size=32, train_steps=200_000, lr=1e-4, eval_interval=1000, eval_iters=250),
+    ),
+)
+
+# Beyond-parity: Llama-3-style 1B with grouped-query attention (4 KV heads
+# for 16 query heads -> 4x smaller KV cache at decode).
+_register(
+    "llama3-1b-gqa",
+    Config(
+        model=_llama_model(
+            vocab_size=32000,
+            context_length=2048,
+            d_model=2048,
+            n_heads=16,
+            n_kv_heads=4,
+            n_layers=22,
+            mlp_ratio=2.6875,
+            attention_impl="flash",
+            remat="dots_saveable",
+        ),
+        mesh=MeshConfig(data=-1, fsdp=4),
+        train=TrainConfig(batch_size=32, lr=3e-4, weight_decay=0.1),
     ),
 )
 

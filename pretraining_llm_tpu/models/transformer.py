@@ -37,7 +37,16 @@ from pretraining_llm_tpu.ops.attention import multihead_attention
 from pretraining_llm_tpu.parallel.sharding import constrain, current_mesh
 
 Params = Dict[str, Any]
-KVCache = Dict[str, jax.Array]  # {'k','v'}: (L, B, Tmax, H, Dh)
+KVCache = Dict[str, jax.Array]  # {'k','v'}: (L, B, Tmax, kv_heads, Dh)
+
+
+def _lm_head_weights(params: Params, cfg: ModelConfig):
+    """(w_out (D, V), bias (V,)|None) — single source of truth for the output
+    head, shared by forward (sampling logits) and loss_fn (chunked CE)."""
+    if cfg.tie_embeddings:
+        return params["tok_embed"]["embedding"].T, None
+    head = params["lm_head"]
+    return head["kernel"], head.get("bias")
 
 
 # ---------------------------------------------------------------------------
@@ -68,11 +77,23 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     def normal(k: jax.Array, shape: Tuple[int, ...], s: float = std) -> jax.Array:
         return (jax.random.normal(k, shape, jnp.float32) * s).astype(dtype)
 
+    g = cfg.kv_heads
+
     def init_block(k: jax.Array) -> Params:
-        ks = jax.random.split(k, 4)
-        attn: Params = {"wqkv": normal(ks[0], (d, 3, h, dh))}
-        if cfg.qkv_bias:
-            attn["bqkv"] = jnp.zeros((3, h, dh), dtype)
+        ks = jax.random.split(k, 5)
+        if g == h:
+            attn: Params = {"wqkv": normal(ks[0], (d, 3, h, dh))}
+            if cfg.qkv_bias:
+                attn["bqkv"] = jnp.zeros((3, h, dh), dtype)
+        else:
+            # GQA: separate q and (smaller) fused kv projections.
+            attn = {
+                "wq": normal(ks[0], (d, h, dh)),
+                "wkv": normal(ks[4], (d, 2, g, dh)),
+            }
+            if cfg.qkv_bias:
+                attn["bq"] = jnp.zeros((h, dh), dtype)
+                attn["bkv"] = jnp.zeros((2, g, dh), dtype)
         if cfg.use_output_proj:
             attn["wo"] = normal(ks[1], (h, dh, d), resid_std)
             attn["bo"] = jnp.zeros((d,), dtype)
@@ -129,18 +150,42 @@ def _attention_block(
     """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     h = layers.apply_norm(cfg.norm, blk["ln1"], x, cfg.norm_eps)
-    qkv = jnp.einsum(
-        "btd,dchn->bcthn", h.astype(cdt), blk["attn"]["wqkv"].astype(cdt),
-        preferred_element_type=jnp.float32,
-    ).astype(cdt)
-    if "bqkv" in blk["attn"]:
-        qkv = qkv + blk["attn"]["bqkv"].astype(cdt)[None, :, None, :, :]
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, T, H, Dh)
+    if "wqkv" in blk["attn"]:
+        qkv = jnp.einsum(
+            "btd,dchn->bcthn", h.astype(cdt), blk["attn"]["wqkv"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        ).astype(cdt)
+        if "bqkv" in blk["attn"]:
+            qkv = qkv + blk["attn"]["bqkv"].astype(cdt)[None, :, None, :, :]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, T, H, Dh)
+    else:
+        # GQA: H query heads, kv_heads <= H key/value heads.
+        q = jnp.einsum(
+            "btd,dhn->bthn", h.astype(cdt), blk["attn"]["wq"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        ).astype(cdt)
+        kvp = jnp.einsum(
+            "btd,dcgn->bctgn", h.astype(cdt), blk["attn"]["wkv"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        ).astype(cdt)
+        if "bq" in blk["attn"]:
+            q = q + blk["attn"]["bq"].astype(cdt)[None, None]
+            kvp = kvp + blk["attn"]["bkv"].astype(cdt)[None, :, None]
+        k, v = kvp[:, 0], kvp[:, 1]  # (B, T, G, Dh)
 
     if rope is not None:
         cos, sin = rope
         q = layers.apply_rope(q, cos, sin, positions)
         k = layers.apply_rope(k, cos, sin, positions)
+
+    # GQA: the naive grouped einsum attends H query heads against G KV heads
+    # directly (no K/V expansion — the cache-bandwidth win). The flash/ring/
+    # ulysses kernels expect equal head counts, so those repeat KV up front
+    # (training-time only; same HBM cost as MHA KV would have had).
+    n_rep = cfg.n_heads // cfg.kv_heads
+
+    def rep(a: jax.Array) -> jax.Array:
+        return jnp.repeat(a, n_rep, axis=2) if n_rep > 1 else a
 
     new_kv: Optional[Tuple[jax.Array, jax.Array]] = None
     if kv is not None:
@@ -168,8 +213,11 @@ def _attention_block(
             kv_mask=kv_mask,
         )
     else:
+        grouped_ok = cfg.attention_impl == "naive"
         out = multihead_attention(
-            q, k, v,
+            q,
+            k if grouped_ok else rep(k),
+            v if grouped_ok else rep(v),
             impl=cfg.attention_impl,
             block_q=cfg.flash_block_q,
             block_kv=cfg.flash_block_kv,
@@ -263,7 +311,7 @@ def forward(
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
     Training/eval: kv_cache=None. Decode: pass a stacked cache
-    {'k','v'}: (L, B, Tmax, H, Dh) plus the integer write offset
+    {'k','v'}: (L, B, Tmax, kv_heads, Dh) plus the integer write offset
     ``cache_index``; the updated cache is returned.
 
     ``return_hidden=True`` additionally returns intermediate activations
@@ -353,15 +401,12 @@ def forward(
         # _chunked_ce); hand back the final-norm hidden states.
         logits = x
     else:
-        if cfg.tie_embeddings:
-            w_out = params["tok_embed"]["embedding"].T
-        else:
-            w_out = params["lm_head"]["kernel"]
+        w_out, head_bias = _lm_head_weights(params, cfg)
         logits = jnp.einsum(
             "btd,dv->btv", x.astype(cdt), w_out.astype(cdt), preferred_element_type=jnp.float32
         )
-        if not cfg.tie_embeddings and "bias" in params.get("lm_head", {}):
-            logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+        if head_bias is not None:
+            logits = logits + head_bias.astype(jnp.float32)
     extras: Tuple[Any, ...] = ()
     if return_hidden:
         extras += ({"block_outputs": block_outputs, "final_hidden": x},)
@@ -441,12 +486,7 @@ def loss_fn(
     hidden, _, aux = forward(
         params, tokens, cfg, return_aux=True, return_pre_logits=True
     )
-    if cfg.tie_embeddings:
-        w_out = params["tok_embed"]["embedding"].T
-        bias = None
-    else:
-        w_out = params["lm_head"]["kernel"]
-        bias = params.get("lm_head", {}).get("bias")
+    w_out, bias = _lm_head_weights(params, cfg)
     loss = _chunked_ce(hidden, w_out, bias, targets, cfg)
     if cfg.n_experts and include_aux:
         loss = loss + cfg.router_aux_coef * aux
@@ -463,5 +503,6 @@ def make_kv_cache(
             f"kv cache max_length={max_length} exceeds context_length={cfg.context_length}"
         )
     dtype = jnp.dtype(dtype or cfg.compute_dtype)
-    shape = (cfg.n_layers, batch_size, max_length, cfg.n_heads, cfg.head_dim)
+    # GQA caches only kv_heads heads — the memory win that motivates GQA.
+    shape = (cfg.n_layers, batch_size, max_length, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -33,32 +33,37 @@ def naive_attention(
     kv_mask: Optional[jax.Array] = None,
     causal: bool = True,
 ) -> jax.Array:
-    """Reference einsum attention. q: (B, Tq, H, Dh); k, v: (B, Tk, H, Dh).
+    """Reference einsum attention. q: (B, Tq, H, Dh); k, v: (B, Tk, G, Dh).
+
+    G (KV heads) may divide H (grouped-query attention): the grouped einsum
+    attends each group of H/G query heads against its shared KV head without
+    materializing repeated K/V — the GQA cache-bandwidth win.
 
     ``q_positions``/``kv_positions`` (shape (Tq,), (Tk,)) define causality for
     KV-cached decode where the query block sits at an offset; they default to
     aligned ranges. ``kv_mask`` (B, Tk) masks out unwritten cache slots.
     """
     b, tq, h, dh = q.shape
-    tk = k.shape[1]
+    tk, g = k.shape[1], k.shape[2]
     scale = 1.0 / (dh**0.5)
+    qg = q.reshape(b, tq, g, h // g, dh)
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # (B, G, H/G, Tq, Tk)
     if causal:
         if q_positions is None:
             q_positions = jnp.arange(tq) + (tk - tq)  # aligned suffix by default
         if kv_positions is None:
             kv_positions = jnp.arange(tk)
         causal_mask = q_positions[:, None] >= kv_positions[None, :]  # (Tq, Tk)
-        scores = jnp.where(causal_mask[None, None, :, :], scores, -jnp.inf)
+        scores = jnp.where(causal_mask[None, None, None, :, :], scores, -jnp.inf)
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, :], scores, -jnp.inf)
+        scores = jnp.where(kv_mask[:, None, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
+        "bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
     )
-    return out.astype(q.dtype)
+    return out.reshape(b, tq, h, dh).astype(q.dtype)
 
 
 def multihead_attention(

@@ -85,6 +85,14 @@ def param_pspec(path_names: Tuple[str, ...], ndim: int, pipeline: bool = False) 
         return blk("fsdp", None, "tensor", None)
     if name == "bqkv":  # (3, H, Dh)
         return blk(None, "tensor", None)
+    if name == "wq":  # (D, H, Dh) — GQA query projection
+        return blk("fsdp", "tensor", None)
+    if name == "bq":  # (H, Dh)
+        return blk("tensor", None)
+    if name == "wkv":  # (D, 2, G, Dh) — GQA kv projection (few heads: replicate G)
+        return blk("fsdp", None, None, None)
+    if name == "bkv":  # (2, G, Dh)
+        return blk(None, None, None)
     if name == "wo":  # (H, Dh, D): row-parallel
         return blk("tensor", None, "fsdp")
     if name == "bo":  # (D,)
