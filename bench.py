@@ -7,10 +7,20 @@ Prints ONE JSON line:
 The BASELINE.json target is >=50% MFU on the 124M GPT-2 config;
 `vs_baseline` is measured_MFU / 0.50 (1.0 = target met).
 
+Resilience: the TPU backend here is reached through a tunnel that can return
+transient UNAVAILABLE errors or hang outright during init. JAX caches a failed
+backend for the life of the process, so retrying in-process is useless —
+instead the default entry point is a thin wrapper that re-execs itself with
+``--_inner`` per attempt, each attempt a fresh process under a hard timeout,
+with exponential backoff between attempts until ``--timeout-budget`` seconds
+are spent. On final failure it prints a structured JSON error line (never a
+traceback) so the driver always gets parseable output.
+
 Usage:
   python bench.py             # full run (gpt2-124m, auto batch)
   python bench.py --quick     # fewer steps, for smoke testing
   python bench.py --preset gpt2-350m-dp --batch 8
+  python bench.py --timeout-budget 1200
 """
 
 from __future__ import annotations
@@ -19,26 +29,14 @@ import argparse
 import dataclasses
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from pretraining_llm_tpu.utils.platform import apply_platform_env
 
-apply_platform_env()
-
-import jax
-import jax.numpy as jnp
-
-from pretraining_llm_tpu.config import get_preset
-from pretraining_llm_tpu.data import loader
-from pretraining_llm_tpu.parallel.mesh import build_mesh
-from pretraining_llm_tpu.training import train_step as ts
-from pretraining_llm_tpu.utils.hardware import device_peak_flops
-
-
-def main() -> None:
+def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser()
     parser.add_argument("--preset", default="gpt2-124m")
     parser.add_argument("--batch", type=int, default=0, help="global batch (0 = preset default)")
@@ -49,7 +47,37 @@ def main() -> None:
     parser.add_argument(
         "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn"]
     )
-    args = parser.parse_args()
+    parser.add_argument(
+        "--timeout-budget",
+        type=float,
+        default=1800.0,
+        help="total seconds across all attempts before giving up with a JSON error",
+    )
+    parser.add_argument(
+        "--attempt-timeout",
+        type=float,
+        default=700.0,
+        help="hard wall-clock cap for a single attempt (compile can take minutes on TPU)",
+    )
+    parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
+    return parser.parse_args(argv)
+
+
+def run_bench(args: argparse.Namespace) -> dict:
+    """One in-process bench attempt. May raise / hang on backend trouble —
+    the wrapper owns retries and timeouts."""
+    from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.data import loader
+    from pretraining_llm_tpu.parallel.mesh import build_mesh
+    from pretraining_llm_tpu.training import train_step as ts
+    from pretraining_llm_tpu.utils.hardware import device_peak_flops
 
     cfg = get_preset(args.preset)
     model = cfg.model
@@ -128,7 +156,7 @@ def main() -> None:
     peak = device_peak_flops() * n_dev
     mfu = tok_per_sec * flops_per_token / peak
 
-    result = {
+    return {
         "metric": f"mfu_{cfg.name}_train",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak_bf16",
@@ -143,8 +171,100 @@ def main() -> None:
         "n_devices": n_dev,
         "loss_finite": bool(jnp.isfinite(loss_v)),
     }
-    print(json.dumps(result))
+
+
+def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
+    return {
+        "metric": f"mfu_{args.preset}_train",
+        "value": 0.0,
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": 0.0,
+        "error": msg[:800],
+        "attempts": attempts,
+    }
+
+
+def wrapper_main(args: argparse.Namespace) -> int:
+    """Retry loop: fresh subprocess per attempt (JAX pins a failed backend for
+    the whole process), hard per-attempt timeout (init can hang, not just
+    raise), exponential backoff, structured JSON error on final failure."""
+    deadline = time.monotonic() + args.timeout_budget
+    backoff = 10.0
+    attempts = 0
+    last_err = "no attempts made (timeout budget too small?)"
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            break
+        attempts += 1
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--_inner",
+            "--preset", args.preset,
+            "--batch", str(args.batch),
+            "--steps", str(args.steps),
+            "--warmup", str(args.warmup),
+        ]
+        if args.quick:
+            cmd.append("--quick")
+        if args.attention:
+            cmd += ["--attention", args.attention]
+        if args.remat:
+            cmd += ["--remat", args.remat]
+        try:
+            proc = subprocess.run(
+                cmd,
+                stdout=subprocess.PIPE,
+                stderr=sys.stderr,
+                timeout=min(args.attempt_timeout, remaining),
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempts} hung past {args.attempt_timeout:.0f}s (killed)"
+            print(f"[bench] {last_err}; retrying", file=sys.stderr)
+            continue
+        out_lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+        if proc.returncode == 0 and out_lines:
+            # Relay the inner run's final JSON line untouched.
+            try:
+                json.loads(out_lines[-1])
+                print(out_lines[-1])
+                return 0
+            except json.JSONDecodeError:
+                last_err = f"attempt {attempts}: non-JSON output: {out_lines[-1][:200]}"
+        else:
+            tail = out_lines[-1][:300] if out_lines else "(no output)"
+            last_err = f"attempt {attempts}: rc={proc.returncode}: {tail}"
+            # A deterministic error (bad flag, import error, ...) won't heal
+            # with retries — relay it now. Only backend/transport flakes loop.
+            transient_markers = (
+                "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
+                "Socket", "socket", "connect", "RESOURCE_EXHAUSTED", "hung",
+            )
+            if out_lines and not any(m in tail for m in transient_markers):
+                try:
+                    json.loads(out_lines[-1])
+                    print(out_lines[-1])
+                    return 1
+                except json.JSONDecodeError:
+                    pass
+        print(f"[bench] {last_err}; backing off {backoff:.0f}s", file=sys.stderr)
+        if time.monotonic() + backoff >= deadline:
+            break
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 120.0)
+    print(json.dumps(error_result(args, last_err, attempts)))
+    return 1
+
+
+def inner_main(args: argparse.Namespace) -> int:
+    try:
+        print(json.dumps(run_bench(args)))
+        return 0
+    except Exception as exc:  # noqa: BLE001 — wrapper parses this line
+        print(json.dumps(error_result(args, f"{type(exc).__name__}: {exc}", 1)))
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    _args = parse_args()
+    sys.exit(inner_main(_args) if _args._inner else wrapper_main(_args))

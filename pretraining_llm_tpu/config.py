@@ -211,13 +211,21 @@ class ModelConfig:
         """Forward+backward training FLOPs per token (6N_active + attention).
 
         Standard approximation used for MFU: 6 * active params for matmul
-        parameters plus 12 * n_layers * d_model * context_length for the
-        attention score/value matmuls (the O(T^2) term). MoE counts only the
-        experts_per_token experts a token executes.
+        parameters plus the attention score/value matmul term (the O(T^2)
+        part). Per layer per token the QK^T and attn@V matmuls each cost
+        2*T*(n_heads*d_head) forward FLOPs, x3 for fwd+bwd = 12*T*d_attn —
+        note d_attn is the *query* attention width ``n_heads * d_head``
+        (GQA shrinks KV projections, not the score matmuls), which differs
+        from d_model whenever d_head is set explicitly. Causal attention
+        computes only ~half the score matrix (and our flash kernel really
+        does skip masked blocks), so the O(T^2) term carries a 1/2 factor —
+        counting the full square would overstate MFU on long contexts.
+        MoE counts only the experts_per_token experts a token executes.
         """
+        d_attn = self.n_heads * self.head_dim
         return (
             6 * self.num_active_params()
-            + 12 * self.n_layers * self.d_model * self.context_length
+            + 12 * self.n_layers * d_attn * self.context_length // 2
         )
 
 
