@@ -89,12 +89,16 @@ def save_checkpoint(
     state: Any,
     *,
     extra: Optional[Dict[str, Any]] = None,
+    local_extra: Optional[Dict[str, Any]] = None,
     keep: int = 3,
 ) -> str:
     """Write `<directory>/step-<step>/` atomically. Returns the final path.
 
     Call from ALL processes in a multi-host run (the barrier is internal);
-    single-host it is just a local atomic write.
+    single-host it is just a local atomic write. `extra` is global metadata
+    (written once, by process 0); `local_extra` is per-process state (e.g.
+    this host's data-sampler RNG) — every process writes its own
+    `local.p<i>.json`, and `load_checkpoint` hands each process back its own.
     """
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f"tmp-{step}")
@@ -106,6 +110,9 @@ def save_checkpoint(
     _barrier()
 
     manifest = [_save_leaf(tmp, name, leaf) for name, leaf in _flatten_with_names(state)]
+    if local_extra:
+        with open(os.path.join(tmp, f"local.p{jax.process_index()}.json"), "w") as f:
+            json.dump(local_extra, f)
     _barrier()
 
     if jax.process_index() == 0:
@@ -185,10 +192,16 @@ def load_checkpoint(path: str, state_template: Any) -> Tuple[Any, Dict[str, Any]
     (ShapeDtypeStructs) works and avoids materializing a throwaway init.
     Returns (numpy_tree, extra_metadata); the caller device_puts with its own
     shardings, so restore is mesh-shape independent: a checkpoint written on
-    one mesh resumes on any other.
+    one mesh resumes on any other. Per-process `local.p<i>.json` entries
+    (see `save_checkpoint`) are merged into the returned extra dict, each
+    process receiving its own — so multi-host data-RNG state resumes exactly.
     """
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    local_path = os.path.join(path, f"local.p{jax.process_index()}.json")
+    if os.path.exists(local_path):
+        with open(local_path) as f:
+            meta.setdefault("extra", {}).update(json.load(f))
     entries = {m["name"]: m for m in meta["manifest"]}
     flat_template = jax.tree_util.tree_flatten_with_path(state_template)
     names = [_leaf_name(p) for p, _ in flat_template[0]]

@@ -67,14 +67,26 @@ class Trainer:
         self.throughput = Throughput(config.model)
 
         # --- data -------------------------------------------------------
+        # Each process samples only its rows of the global batch
+        # (batch_size / process_count); `_put` assembles the global sharded
+        # array from the per-process pieces. Single-process this is the
+        # identity arrangement.
         mcfg, dcfg, tcfg = config.model, config.data, config.train
+        n_proc = jax.process_count()
+        if tcfg.batch_size % n_proc != 0:
+            raise ValueError(
+                f"batch_size={tcfg.batch_size} must divide by process_count={n_proc}"
+            )
+        local_batch = tcfg.batch_size // n_proc
         if train_iterator is None:
             if synthetic_data:
+                # Decorrelate hosts the same way the file loader does.
+                host_seed = dcfg.sample_seed + 7919 * jax.process_index()
                 train_iterator = data_loader.synthetic_iterator(
-                    mcfg.vocab_size, mcfg.context_length, tcfg.batch_size, dcfg.sample_seed
+                    mcfg.vocab_size, mcfg.context_length, local_batch, host_seed
                 )
                 val_iterator = data_loader.synthetic_iterator(
-                    mcfg.vocab_size, mcfg.context_length, tcfg.batch_size, dcfg.sample_seed + 1
+                    mcfg.vocab_size, mcfg.context_length, local_batch, host_seed + 1
                 )
             else:
                 train_iterator = self._make_iterator(dcfg.train_path, dcfg.sample_seed)
@@ -84,9 +96,21 @@ class Trainer:
 
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, batch_pspec(mcfg.sequence_parallel))
-            self._put = lambda b: jax.device_put(
-                (jnp.asarray(b[0]), jnp.asarray(b[1])), (sharding, sharding)
-            )
+            if n_proc > 1:
+                # Host-local rows -> global sharded array. Assumes only the
+                # batch dim spans processes (seq stays within a host), the
+                # standard pod layout: batch over DCN, model axes over ICI.
+                global_shape = (tcfg.batch_size, mcfg.context_length)
+                self._put = lambda b: tuple(
+                    jax.make_array_from_process_local_data(
+                        sharding, np.ascontiguousarray(a), global_shape
+                    )
+                    for a in b
+                )
+            else:
+                self._put = lambda b: jax.device_put(
+                    (jnp.asarray(b[0]), jnp.asarray(b[1])), (sharding, sharding)
+                )
         else:
             self._put = lambda b: (jnp.asarray(b[0]), jnp.asarray(b[1]))
 
@@ -113,15 +137,20 @@ class Trainer:
         self.state = state
 
     def _make_iterator(self, path: str, seed: int):
-        """File iterator: native C++ gatherer when built, numpy otherwise."""
+        """File iterator: native C++ gatherer when built, numpy otherwise.
+
+        Samples this process's rows only (batch_size / process_count) from
+        this process's contiguous token-stream shard.
+        """
         dcfg, tcfg, mcfg = self.config.data, self.config.train, self.config.model
+        local_batch = tcfg.batch_size // jax.process_count()
         if dcfg.use_native_batcher:
             try:
                 from pretraining_llm_tpu.data.native_batcher import NativeBatchIterator
 
                 return NativeBatchIterator(
                     path,
-                    tcfg.batch_size,
+                    local_batch,
                     mcfg.context_length,
                     seed=seed,
                     shard_index=jax.process_index(),
@@ -131,7 +160,7 @@ class Trainer:
                 pass  # no toolchain / unreadable: numpy path below
         return data_loader.get_batch_iterator(
             path,
-            tcfg.batch_size,
+            local_batch,
             mcfg.context_length,
             seed=seed,
             shard_index=jax.process_index(),
@@ -149,18 +178,24 @@ class Trainer:
         return float(jnp.mean(jnp.stack(losses)))
 
     def save(self, step: int) -> str:
+        """Write a checkpoint. Call from ALL processes in a multi-host run —
+        every process persists its own array shards and data-RNG state;
+        process 0 alone writes the global metadata (the gating lives inside
+        `checkpoint.save_checkpoint`, not here)."""
         extra: Dict[str, Any] = {
             "step": step,
             "config": dataclasses.asdict(self.config),
             "preset": self.config.name,
         }
+        local_extra: Dict[str, Any] = {}
         if hasattr(self.train_iterator, "state"):
-            extra["data_rng"] = self.train_iterator.state()
+            local_extra["data_rng"] = self.train_iterator.state()
         return ckpt.save_checkpoint(
             self.config.train.checkpoint_dir,
             step,
             self.state,
             extra=extra,
+            local_extra=local_extra,
             keep=self.config.train.keep_checkpoints,
         )
 
@@ -202,24 +237,30 @@ class Trainer:
                         self.logger.log({"step": step + 1, "val_loss": val_loss})
                 if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
                     off_path = True
-                    if is_host0:
-                        self.save(step + 1)
+                    # ALL processes: each writes its own shards; the barrier
+                    # and metadata gating are inside save_checkpoint.
+                    self.save(step + 1)
                 if off_path:
                     self.throughput.reset_clock()  # keep eval/ckpt time out of step_ms
         except Exception as e:
             # Failure recovery (SURVEY §5): persist the last good state before
             # propagating. self.state is the step-(k-1) output and still valid
-            # even though the failing step's donated inputs are gone.
+            # even though the failing step's donated inputs are gone. All
+            # processes attempt the save: step failures are collective in SPMD
+            # (same program, same data-dependent fault); a genuinely host-local
+            # fault leaves the others stuck in a collective anyway, and the
+            # distributed runtime's barrier timeout is the backstop for both.
             if is_host0:
                 self.logger.log({"event": "failure", "step": step, "error": repr(e)[:200]})
-                try:
-                    self.save(step)
-                except Exception as save_err:  # keep the original error primary
+            try:
+                self.save(step)
+            except Exception as save_err:  # keep the original error primary
+                if is_host0:
                     self.logger.log({"event": "emergency_save_failed", "error": repr(save_err)[:200]})
             raise
         finally:
             profiler.close()
 
-        if is_host0 and (tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0):
+        if tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0:
             self.save(total)
         return last

@@ -1,0 +1,85 @@
+"""Subprocess worker for the 2-process distributed checkpoint/resume test.
+
+Each worker is one "host" of a 2-process jax.distributed run on the CPU
+backend (2 local devices -> 4 global devices), exercising the real multi-host
+code paths the reference never tested (its DDP launch at
+/root/reference/scripts/train_transformer.py:15-29 shipped broken — SURVEY §A):
+cross-host mesh construction, `make_array_from_process_local_data` batch
+assembly, all-process checkpoint save with internal barriers, and per-process
+data-RNG resume.
+
+Modes:
+  straight  train 6 steps in one run
+  part1     train 3 steps (periodic checkpoint lands at step 3), exit = "kill"
+  part2     resume from the step-3 checkpoint, train to step 6
+
+The final-step loss of part2 must bit-exactly equal straight's.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["straight", "part1", "part2"], required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--workdir", required=True)
+    args = ap.parse_args()
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{args.port}",
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == args.num_processes
+    assert jax.device_count() == 2 * args.num_processes
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.training.trainer import Trainer
+
+    cfg = get_preset("tiny")
+    cfg = cfg.replace(
+        train=dataclasses.replace(
+            cfg.train,
+            batch_size=8,
+            train_steps=6,
+            checkpoint_interval=3,
+            checkpoint_dir=os.path.join(args.workdir, "ckpt"),
+            eval_interval=0,
+            log_interval=1,
+            metrics_path="",
+        )
+    )
+    steps = {"straight": 6, "part1": 3, "part2": 6}[args.mode]
+    trainer = Trainer(cfg, synthetic_data=True, resume=True)
+    if args.mode == "part2":
+        assert trainer.start_step == 3, f"expected resume from step 3, got {trainer.start_step}"
+    last = trainer.train(steps=steps)
+
+    out = {
+        "mode": args.mode,
+        "process": args.process_id,
+        "start_step": trainer.start_step,
+        "loss": last["loss"],
+    }
+    path = os.path.join(args.workdir, f"result.{args.mode}.p{args.process_id}.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
