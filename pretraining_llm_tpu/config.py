@@ -65,6 +65,11 @@ class ModelConfig:
     compute_dtype: str = "bfloat16"
     # Attention implementation: naive einsum | pallas flash | ring (seq-parallel)
     attention_impl: str = "naive"
+    # Sequence distribution for ring attention: "zigzag" pairs chunk i with
+    # chunk 2n-1-i per device so causal work balances across the ring
+    # (utilization ~1.0 vs (n+1)/2n contiguous); loss_fn applies the matching
+    # token permutation automatically. "contiguous" keeps plain sharding.
+    ring_layout: str = "zigzag"
     # Flash-attention block sizes (tuned for TPU MXU/VMEM; 0 = auto)
     flash_block_q: int = 0
     flash_block_kv: int = 0
@@ -99,6 +104,10 @@ class ModelConfig:
             )
         if self.remat not in _REMAT_POLICIES:
             raise ValueError(f"remat must be one of {_REMAT_POLICIES}, got {self.remat!r}")
+        if self.ring_layout not in ("contiguous", "zigzag"):
+            raise ValueError(
+                f"ring_layout must be 'contiguous' or 'zigzag', got {self.ring_layout!r}"
+            )
         if self.d_model % self.n_heads != 0 and self.d_head is None:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by n_heads={self.n_heads}; set d_head"

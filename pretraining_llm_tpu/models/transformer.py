@@ -146,6 +146,7 @@ def _attention_block(
     positions: jax.Array,
     kv: Optional[Tuple[jax.Array, jax.Array]],
     cache_index: Optional[jax.Array],
+    zigzag: bool = False,
 ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
     """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv)."""
     cdt = jnp.dtype(cfg.compute_dtype)
@@ -221,6 +222,7 @@ def _attention_block(
             impl=cfg.attention_impl,
             block_q=cfg.flash_block_q,
             block_kv=cfg.flash_block_kv,
+            ring_layout="zigzag" if zigzag else "contiguous",
         )
 
     # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,
@@ -279,8 +281,9 @@ def _block(
     positions: jax.Array,
     kv: Optional[Tuple[jax.Array, jax.Array]],
     cache_index: Optional[jax.Array],
+    zigzag: bool = False,
 ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]], jax.Array]:
-    x, new_kv = _attention_block(blk, x, cfg, rope, positions, kv, cache_index)
+    x, new_kv = _attention_block(blk, x, cfg, rope, positions, kv, cache_index, zigzag)
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
@@ -307,6 +310,7 @@ def forward(
     return_hidden: bool = False,
     return_aux: bool = False,
     return_pre_logits: bool = False,
+    zigzag: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
@@ -321,6 +325,12 @@ def forward(
 
     ``return_aux=True`` additionally returns the summed MoE router
     load-balance loss (zero for dense models).
+
+    ``zigzag=True`` declares that the caller permuted the sequence dim with
+    `parallel.zigzag.zigzag_perm` (and passed the matching ``positions``);
+    ring attention then uses the balanced zigzag chunk layout. loss_fn
+    manages this automatically — set it manually only if you permute inputs
+    yourself.
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t = tokens.shape
@@ -340,7 +350,7 @@ def forward(
         x, aux_sum = carry
         if kv_cache is None:
             blk = layer_inputs
-            x, _, aux = _block(blk, x, cfg, rope, positions, None, None)
+            x, _, aux = _block(blk, x, cfg, rope, positions, None, None, zigzag)
             return (x, aux_sum + aux), (x if return_hidden else None)
         blk, ck, cv = layer_inputs
         x, new_kv, aux = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
@@ -375,7 +385,7 @@ def forward(
         from pretraining_llm_tpu.parallel import pipeline
 
         def pipe_block(blk, h):
-            h, _, aux = _block(blk, h, cfg, rope, positions, None, None)
+            h, _, aux = _block(blk, h, cfg, rope, positions, None, None, zigzag)
             return h, aux
 
         x, aux_total = pipeline.pipeline_apply(
@@ -482,9 +492,29 @@ def loss_fn(
     ``cfg.router_aux_coef`` when ``include_aux`` (training objective); eval
     passes include_aux=False so reported val_loss stays pure cross-entropy,
     comparable across dense and MoE models.
+
+    With zigzag ring attention active (attention_impl='ring',
+    ring_layout='zigzag', a seq>1 mesh), tokens/targets/positions are
+    permuted here into the balanced chunk-pair layout — mean CE is
+    permutation invariant, so the loss value is identical to the dense
+    computation (tested) while causal ring work balances across devices.
     """
+    positions = None
+    zigzag = False
+    if cfg.attention_impl == "ring" and cfg.ring_layout == "zigzag":
+        mesh = current_mesh()
+        n_seq = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if n_seq > 1 and tokens.shape[1] % (2 * n_seq) == 0:
+            from pretraining_llm_tpu.parallel.zigzag import zigzag_perm
+
+            perm = zigzag_perm(tokens.shape[1], n_seq)
+            tokens = tokens[:, perm]
+            targets = targets[:, perm]
+            positions = jnp.asarray(perm)
+            zigzag = True
     hidden, _, aux = forward(
-        params, tokens, cfg, return_aux=True, return_pre_logits=True
+        params, tokens, cfg, positions=positions, zigzag=zigzag,
+        return_aux=True, return_pre_logits=True,
     )
     w_out, bias = _lm_head_weights(params, cfg)
     loss = _chunked_ce(hidden, w_out, bias, targets, cfg)

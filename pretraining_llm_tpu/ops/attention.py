@@ -78,6 +78,7 @@ def multihead_attention(
     kv_mask: Optional[jax.Array] = None,
     block_q: int = 0,
     block_kv: int = 0,
+    ring_layout: str = "contiguous",
 ) -> jax.Array:
     """Dispatch over attention implementations.
 
@@ -85,6 +86,8 @@ def multihead_attention(
     mesh's 'seq' axis, read from `parallel.sharding.current_mesh()` at trace
     time). Without a seq axis, or for KV-cached decode (kv_mask set), it
     degrades to the dense path — the correct single-shard form.
+    ``ring_layout="zigzag"`` asserts the caller already zigzag-permuted the
+    sequence dim (models.transformer.loss_fn does this).
     """
     if impl in ("ring", "ulysses"):
         from pretraining_llm_tpu.parallel.sharding import current_mesh
@@ -94,7 +97,10 @@ def multihead_attention(
             if impl == "ring":
                 from pretraining_llm_tpu.parallel.ring_attention import ring_attention
 
-                return ring_attention(q, k, v, mesh, causal=causal)
+                return ring_attention(
+                    q, k, v, mesh, causal=causal, layout=ring_layout,
+                    block_kv=block_kv or 512,
+                )
             from pretraining_llm_tpu.parallel.ulysses import ulysses_attention
 
             return ulysses_attention(

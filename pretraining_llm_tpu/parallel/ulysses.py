@@ -65,7 +65,7 @@ def ulysses_attention(
     seq_axis: str = "seq",
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
-    use_flash: bool = False,
+    use_flash: bool = True,
     block_q: int = 0,
     block_kv: int = 0,
 ) -> jax.Array:

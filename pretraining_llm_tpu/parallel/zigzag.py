@@ -1,0 +1,48 @@
+"""Zigzag sequence layout for balanced causal context parallelism.
+
+Contiguous sequence sharding under causal masking is pathologically
+imbalanced: device 0's tokens attend only to themselves (one ring hop of
+work) while device n-1 attends to everything (n hops). The zigzag layout
+splits the global sequence into 2n equal chunks and gives device i the pair
+(i, 2n-1-i) — one early chunk, one late chunk — so every device does the
+same causal work on every hop (see parallel.ring_attention._ring_local).
+
+The permutation is applied to the token stream once, host/trace-side, before
+the model: `x_zz = x[:, perm]`. Targets permute with the same index map (y is
+the shift-by-1 of x POSITION-wise, so permuting both keeps x_zz[i] -> y_zz[i]
+pairs intact), position ids become `perm` itself (RoPE / learned embeddings
+then see true global positions), and the mean CE loss is permutation
+invariant — nothing needs un-permuting during training.
+
+All functions are pure numpy on static shapes: the permutation is a compile
+time constant baked into the jitted step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def zigzag_perm(seq_len: int, n_shards: int) -> np.ndarray:
+    """perm[p] = original position of the token at zigzag-layout index p.
+
+    Layout index space: device i owns [i*L, (i+1)*L) with L = seq_len//n,
+    holding original chunks i then 2n-1-i, each of size L//2.
+    """
+    if seq_len % (2 * n_shards) != 0:
+        raise ValueError(
+            f"seq_len={seq_len} must divide by 2*n_shards={2 * n_shards} for zigzag"
+        )
+    c = seq_len // (2 * n_shards)
+    chunks = np.arange(seq_len).reshape(2 * n_shards, c)
+    order = []
+    for i in range(n_shards):
+        order += [i, 2 * n_shards - 1 - i]
+    return chunks[order].reshape(-1)
+
+
+def inverse_perm(perm: np.ndarray) -> np.ndarray:
+    """inv[orig] = zigzag index holding original position `orig`."""
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return inv
