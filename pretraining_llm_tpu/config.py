@@ -86,6 +86,11 @@ class ModelConfig:
     experts_per_token: int = 2
     expert_capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Tokens per routing group: capacity pools are per-group so dispatch
+    # memory is O(S * k * C_group), linear in batch, not O(S^2). Group count
+    # derives from the token count only (mesh-independent routing). 0 = one
+    # global group (tiny-shape/testing escape hatch).
+    moe_group_size: int = 2048
     # Pipeline parallelism: split the layer stack into stages over the 'pipe'
     # mesh axis, GPipe microbatch schedule via ppermute. 1 = off.
     pipeline_stages: int = 1
@@ -131,6 +136,8 @@ class ModelConfig:
                 )
             if self.expert_capacity_factor <= 0:
                 raise ValueError("expert_capacity_factor must be positive")
+            if self.moe_group_size < 0:
+                raise ValueError("moe_group_size must be >= 0 (0 = one global group)")
         if self.pipeline_stages < 1 or self.n_layers % self.pipeline_stages != 0:
             raise ValueError(
                 f"pipeline_stages={self.pipeline_stages} must divide "
@@ -359,6 +366,21 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     name: str = "custom"
+
+    def __post_init__(self) -> None:
+        # Pipeline sharding assigns block params P('pipe', ...) — the stage
+        # split replaces per-weight expert/tensor/fsdp specs (each stage
+        # computes on whole weights). A mesh that also sizes those axes >1
+        # would silently replicate every block weight across them; reject it.
+        if self.model.pipeline_stages > 1 and (
+            self.mesh.expert > 1 or self.mesh.tensor > 1 or self.mesh.fsdp > 1
+        ):
+            raise ValueError(
+                "pipeline_stages>1 shards block params over 'pipe' only; "
+                "combine it with data parallelism, not expert/tensor/fsdp "
+                f"axes (got mesh expert={self.mesh.expert} "
+                f"tensor={self.mesh.tensor} fsdp={self.mesh.fsdp})"
+            )
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)

@@ -4,13 +4,20 @@ Beyond-parity component: the reference has only a dense MLP
 (`/root/reference/src/models/mlp.py:24-26`); SURVEY §2.2 lists EP as the one
 parallelism strategy left open. This is the TPU-native design:
 
-  - **Dense einsum dispatch** (Switch/Mixtral-style token choice with a static
-    per-expert capacity): routing is expressed as two big einsums against
+  - **Grouped dense einsum dispatch** (Switch/Mixtral-style token choice with
+    a static per-expert capacity): routing is expressed as einsums against
     one-hot dispatch/combine tensors, so every shape is static, everything
     lands on the MXU, and under `pjit` the dispatch contraction over the token
     dim *is* the all-to-all — XLA inserts the collective from the shardings
     (tokens sharded over 'data', experts over 'expert'), no hand-written
     routing tables or ragged buffers.
+  - Routing is computed per **group** of `cfg.moe_group_size` tokens
+    (flaxformer-style), with capacity proportional to the group size, so the
+    dispatch/combine tensors are O(S * k * C_group) — linear in the batch —
+    instead of the O(S^2) a single global capacity pool costs. Group count
+    depends only on the token count (never the mesh), so routing decisions
+    are identical across mesh shapes (sharding-invariance holds); the group
+    dim stays sharded over the data axes while experts shard over 'expert'.
   - Top-k gating with renormalized weights, slot-major capacity priority
     (every token's 1st choice is placed before any token's 2nd choice),
     dropped tokens fall back to the residual stream (their MoE output is 0).
@@ -101,28 +108,62 @@ def route(
     return dispatch, combine, aux
 
 
-def moe_mlp(mlp: Params, h: jax.Array, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
-    """MoE FFN on normed input h (B, T, D) -> (output (B, T, D), aux loss)."""
+def _group_count(s: int, group_size: int) -> int:
+    """Number of routing groups: S/group_size rounded to a divisor of S.
+
+    Depends only on the token count (never the mesh) so routing is identical
+    across mesh shapes.
+    """
+    if group_size <= 0 or s <= group_size:
+        return 1
+    g = s // group_size
+    while s % g != 0:  # token counts are powers of two in practice; be safe
+        g -= 1
+    return g
+
+
+def moe_mlp(
+    mlp: Params, h: jax.Array, cfg: ModelConfig, *, decode: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN on normed input h (B, T, D) -> (output (B, T, D), aux loss).
+
+    ``decode=True`` (KV-cached generation) routes without a capacity bound:
+    per-step token counts are tiny and a capacity drop there would make a
+    token's output depend on which other sequences are co-batched.
+    """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t, d = h.shape
     s = b * t
-    x = h.reshape(s, d)
+    g = 1 if decode else _group_count(s, cfg.moe_group_size)
+    sg = s // g
+    x = h.reshape(g, sg, d)
 
     router_logits = jnp.einsum(
-        "sd,de->se",
+        "gsd,de->gse",
         x.astype(jnp.float32),
         mlp["router"].astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
-    capacity = expert_capacity(cfg, s)
-    dispatch, combine, aux = route(router_logits, cfg, capacity)
+    capacity = sg if decode else expert_capacity(cfg, sg)
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: route(lg, cfg, capacity)
+    )(router_logits)
+    aux = jnp.mean(aux)
 
     # Contracting the (data-sharded) token dim against the dispatch mask IS
     # the all-to-all: XLA lowers it to collectives between the 'data' and
-    # 'expert' mesh axes.
-    xin = jnp.einsum(
-        "sec,sd->ecd", dispatch.astype(cdt), x.astype(cdt), preferred_element_type=jnp.float32
-    ).astype(cdt)
+    # 'expert' mesh axes. The group dim rides the data axes.
+    # Accumulation precision is a non-issue here (each (e, c) slot gathers
+    # exactly one token), but the grouped form makes these genuinely batched
+    # dots and the CPU backend has no batched-bf16 DotThunk — route them
+    # through fp32 there. TPU keeps bf16 (MXU accumulates fp32 natively).
+    ddt = jnp.float32 if jax.default_backend() == "cpu" else cdt
+    xin = jnp.einsum("gsec,gsd->gecd", dispatch.astype(ddt), x.astype(ddt)).astype(cdt)
+    # Fold (g, c) into one per-expert row dim: each expert runs ONE
+    # (G*C, D) @ (D, F) matmul — bigger MXU tiles than G separate dots, and
+    # the same non-batched lowering the CPU backend supports in bf16.
+    gc = g * capacity
+    xin = xin.transpose(1, 0, 2, 3).reshape(cfg.n_experts, gc, d)
     xin = constrain(xin, "expert", None, None)
 
     ex = mlp["experts"]
@@ -148,6 +189,9 @@ def moe_mlp(mlp: Params, h: jax.Array, cfg: ModelConfig) -> Tuple[jax.Array, jax
     if "b2" in ex:
         out = out + ex["b2"].astype(cdt)[:, None, :]
     out = constrain(out, "expert", None, None)
+    out = out.reshape(cfg.n_experts, g, capacity, d).transpose(1, 0, 2, 3)
 
-    y = jnp.einsum("sec,ecd->sd", combine.astype(cdt), out, preferred_element_type=jnp.float32)
+    # Combine sums exactly experts_per_token (~2) terms per token: bf16
+    # accumulation is exact enough; same CPU batched-dot dtype caveat as xin.
+    y = jnp.einsum("gsec,gecd->gsd", combine.astype(ddt), out.astype(ddt))
     return y.astype(h.dtype).reshape(b, t, d), aux

@@ -243,13 +243,15 @@ def _attention_block(
     return x + out.astype(x.dtype), new_kv
 
 
-def _mlp_block(blk: Params, x: jax.Array, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+def _mlp_block(
+    blk: Params, x: jax.Array, cfg: ModelConfig, decode: bool = False
+) -> Tuple[jax.Array, jax.Array]:
     """Pre-LN MLP sub-block: x + mlp(ln2(x)). Returns (x, router aux loss)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     h = layers.apply_norm(cfg.norm, blk["ln2"], x, cfg.norm_eps).astype(cdt)
     mlp = blk["mlp"]
     if cfg.n_experts:
-        out, aux = moe.moe_mlp(mlp, h, cfg)
+        out, aux = moe.moe_mlp(mlp, h, cfg, decode=decode)
         return x + out.astype(x.dtype), aux
     if cfg.activation == "swiglu":
         gates = jnp.einsum(
@@ -287,7 +289,10 @@ def _block(
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
-    x, aux = _mlp_block(blk, x, cfg)
+    # Uncapacitated MoE routing only for single-token decode steps: prefill
+    # processes whole prompts, where capacity = token count would rebuild the
+    # O(S^2) dispatch the grouped path exists to avoid.
+    x, aux = _mlp_block(blk, x, cfg, decode=kv is not None and x.shape[1] == 1)
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
@@ -453,10 +458,25 @@ def _chunked_ce(
     want = max(1, -(-logits_bytes // (512 * 1024 * 1024)))
     n_chunks = 1
     if want > 1:
-        for cand in range(want, 4 * want + 1):
-            if s % cand == 0 and s // cand >= 512:
-                n_chunks = cand
-                break
+        # Any divisor of S with chunk >= 512 keeps the memory bound; prefer
+        # the smallest chunk count >= want, else the largest available (an
+        # awkward S loses granularity, not the whole saving).
+        divisors = [c for c in range(2, s // 512 + 1) if s % c == 0]
+        at_least = [c for c in divisors if c >= want]
+        if at_least:
+            n_chunks = min(at_least)
+        elif divisors:
+            n_chunks = max(divisors)
+        if n_chunks < want:
+            import warnings
+
+            warnings.warn(
+                f"chunked CE head: batch*seq={s} has no divisor >= {want} with "
+                f"chunk >= 512; using {n_chunks} chunks — logits memory "
+                f"{logits_bytes / n_chunks / 2**20:.0f} MB/chunk exceeds the "
+                "512 MB target. Prefer power-of-two batch*context products.",
+                stacklevel=2,
+            )
     xs = hidden.reshape(n_chunks, s // n_chunks, d)
     ts_ = targets.reshape(n_chunks, s // n_chunks)
 
@@ -504,14 +524,25 @@ def loss_fn(
     if cfg.attention_impl == "ring" and cfg.ring_layout == "zigzag":
         mesh = current_mesh()
         n_seq = mesh.shape.get("seq", 1) if mesh is not None else 1
-        if n_seq > 1 and tokens.shape[1] % (2 * n_seq) == 0:
-            from pretraining_llm_tpu.parallel.zigzag import zigzag_perm
+        if n_seq > 1:
+            if tokens.shape[1] % (2 * n_seq) == 0:
+                from pretraining_llm_tpu.parallel.zigzag import zigzag_perm
 
-            perm = zigzag_perm(tokens.shape[1], n_seq)
-            tokens = tokens[:, perm]
-            targets = targets[:, perm]
-            positions = jnp.asarray(perm)
-            zigzag = True
+                perm = zigzag_perm(tokens.shape[1], n_seq)
+                tokens = tokens[:, perm]
+                targets = targets[:, perm]
+                positions = jnp.asarray(perm)
+                zigzag = True
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"ring_layout='zigzag' configured but seq_len="
+                    f"{tokens.shape[1]} is not divisible by 2*seq_axis="
+                    f"{2 * n_seq}; falling back to the imbalanced contiguous "
+                    "ring layout (utilization ~(n+1)/2n).",
+                    stacklevel=2,
+                )
     hidden, _, aux = forward(
         params, tokens, cfg, positions=positions, zigzag=zigzag,
         return_aux=True, return_pre_logits=True,

@@ -166,3 +166,27 @@ def build_eval_step(
             return transformer.loss_fn(state["params"], x, y, model_cfg, include_aux=False)
 
     return jax.jit(eval_fn)
+
+
+def build_eval_loop(
+    cfg: Config, mesh: Optional[Mesh] = None
+) -> Callable[[TrainState, Tuple[jax.Array, jax.Array]], jax.Array]:
+    """Mean eval loss over a stacked batch set in ONE dispatch.
+
+    batches: (x, y) each (N, B, T). A `lax.scan` over the N eval batches runs
+    device-side — versus N individual eval_fn dispatches (each a host round
+    trip on remote platforms), this is one launch and one scalar fetch.
+    """
+    model_cfg = cfg.model
+
+    def eval_many(state: TrainState, batches: Tuple[jax.Array, jax.Array]) -> jax.Array:
+        def body(acc, xy):
+            x, y = xy
+            with activation_mesh(mesh):
+                loss = transformer.loss_fn(state["params"], x, y, model_cfg, include_aux=False)
+            return acc + loss, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batches)
+        return total / batches[0].shape[0]
+
+    return jax.jit(eval_many)

@@ -22,7 +22,7 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pretraining_llm_tpu.config import Config
 from pretraining_llm_tpu.data import loader as data_loader
@@ -63,8 +63,9 @@ class Trainer:
         self.mesh = mesh if mesh is not None else (build_mesh(config.mesh) if needs_mesh else None)
         self.logger = logger or MetricsLogger(config.train.metrics_path)
         self.step_fn = ts.build_train_step(config, self.mesh)
-        self.eval_fn = ts.build_eval_step(config, self.mesh)
+        self.eval_loop = ts.build_eval_loop(config, self.mesh)
         self.throughput = Throughput(config.model)
+        self._synthetic_data = synthetic_data
 
         # --- data -------------------------------------------------------
         # Each process samples only its rows of the global batch
@@ -85,34 +86,52 @@ class Trainer:
                 train_iterator = data_loader.synthetic_iterator(
                     mcfg.vocab_size, mcfg.context_length, local_batch, host_seed
                 )
-                val_iterator = data_loader.synthetic_iterator(
-                    mcfg.vocab_size, mcfg.context_length, local_batch, host_seed + 1
-                )
             else:
                 train_iterator = self._make_iterator(dcfg.train_path, dcfg.sample_seed)
-                val_iterator = self._make_iterator(dcfg.val_path, dcfg.sample_seed + 1)
         self.train_iterator = train_iterator
+        # None = build a fresh deterministic eval set per evaluate() call;
+        # a caller-injected iterator is consumed as a stream instead.
         self.val_iterator = val_iterator
 
         if self.mesh is not None:
             sharding = NamedSharding(self.mesh, batch_pspec(mcfg.sequence_parallel))
+            eval_sharding = NamedSharding(
+                self.mesh, P(None, *batch_pspec(mcfg.sequence_parallel))
+            )
             if n_proc > 1:
                 # Host-local rows -> global sharded array. Assumes only the
                 # batch dim spans processes (seq stays within a host), the
                 # standard pod layout: batch over DCN, model axes over ICI.
-                global_shape = (tcfg.batch_size, mcfg.context_length)
-                self._put = lambda b: tuple(
-                    jax.make_array_from_process_local_data(
-                        sharding, np.ascontiguousarray(a), global_shape
+                def put(b):
+                    global_shape = (tcfg.batch_size, mcfg.context_length)
+                    return tuple(
+                        jax.make_array_from_process_local_data(
+                            sharding, np.ascontiguousarray(a), global_shape
+                        )
+                        for a in b
                     )
-                    for a in b
-                )
+
+                def put_eval(b):
+                    n = b[0].shape[0]
+                    global_shape = (n, tcfg.batch_size, mcfg.context_length)
+                    return tuple(
+                        jax.make_array_from_process_local_data(
+                            eval_sharding, np.ascontiguousarray(a), global_shape
+                        )
+                        for a in b
+                    )
+
+                self._put, self._put_eval = put, put_eval
             else:
                 self._put = lambda b: jax.device_put(
                     (jnp.asarray(b[0]), jnp.asarray(b[1])), (sharding, sharding)
                 )
+                self._put_eval = lambda b: jax.device_put(
+                    (jnp.asarray(b[0]), jnp.asarray(b[1])), (eval_sharding, eval_sharding)
+                )
         else:
             self._put = lambda b: (jnp.asarray(b[0]), jnp.asarray(b[1]))
+            self._put_eval = self._put
 
         # --- state: fresh init or resume-from-latest ----------------------
         self.start_step = 0
@@ -168,14 +187,31 @@ class Trainer:
         )
 
     # ------------------------------------------------------------------
+    def _fresh_val_iterator(self):
+        """A NEW deterministic iterator per evaluate() call: the same eval
+        batches every time (and across resumes), so val_loss is comparable
+        run-to-run — unlike sampling from an advancing stream."""
+        mcfg, dcfg, tcfg = self.config.model, self.config.data, self.config.train
+        eval_seed = dcfg.sample_seed + 104729  # fixed, never advanced
+        if self._synthetic_data:
+            local_batch = tcfg.batch_size // jax.process_count()
+            return data_loader.synthetic_iterator(
+                mcfg.vocab_size, mcfg.context_length,
+                local_batch, eval_seed + 7919 * jax.process_index(),
+            )
+        return self._make_iterator(dcfg.val_path, eval_seed)
+
     def evaluate(self, iters: Optional[int] = None) -> float:
-        """Mean val loss over `iters` batches (reference: _evaluate, l.51-62)."""
+        """Mean val loss over `iters` fixed batches (reference: _evaluate,
+        l.51-62 — but deterministic, and ONE device dispatch, not `iters`)."""
         iters = iters or self.config.train.eval_iters
-        losses = []
-        for _ in range(iters):
-            batch = self._put(next(self.val_iterator))
-            losses.append(self.eval_fn(self.state, batch))
-        return float(jnp.mean(jnp.stack(losses)))
+        if self.val_iterator is not None:
+            it = self.val_iterator  # caller-injected stream: use as-is
+        else:
+            it = self._fresh_val_iterator()
+        xs, ys = zip(*(next(it) for _ in range(iters)))
+        batch = (np.stack(xs), np.stack(ys))
+        return float(self.eval_loop(self.state, self._put_eval(batch)))
 
     def save(self, step: int) -> str:
         """Write a checkpoint. Call from ALL processes in a multi-host run —

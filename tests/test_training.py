@@ -180,6 +180,26 @@ def test_trainer_eval_and_metrics(tmp_path, capsys):
     assert any("tokens_per_sec" in r for r in records)
 
 
+def test_eval_deterministic_across_calls_and_training(tmp_path):
+    """evaluate() uses a fixed seeded eval set: identical loss on repeated
+    calls, and unaffected by how far training has advanced the train stream."""
+    cfg = _tiny_config(
+        train_steps=2,
+        eval_iters=3,
+        checkpoint_interval=0,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    t = Trainer(cfg, synthetic_data=True, resume=False)
+    v1 = t.evaluate()
+    v2 = t.evaluate()
+    assert v1 == v2  # bit-identical: same batches, same one-dispatch program
+    t.train(steps=2)
+    t2 = Trainer(cfg, synthetic_data=True, resume=False)
+    # Fresh trainer, same config: same eval batches (params differ, so only
+    # check the batch stream by re-evaluating the ORIGINAL params' loss).
+    assert t2.evaluate() == v1
+
+
 def test_checkpoint_sharded_leaf_reassembly(tmp_path):
     """Multi-host shard file format: split leaves reassemble exactly."""
     import json as _json
