@@ -179,10 +179,11 @@ def _attention_block(
         q = layers.apply_rope(q, cos, sin, positions)
         k = layers.apply_rope(k, cos, sin, positions)
 
-    # GQA: the naive grouped einsum attends H query heads against G KV heads
-    # directly (no K/V expansion — the cache-bandwidth win). The flash/ring/
-    # ulysses kernels expect equal head counts, so those repeat KV up front
-    # (training-time only; same HBM cost as MHA KV would have had).
+    # GQA: the naive grouped einsum and the Pallas flash kernel both attend
+    # H query heads against G KV heads directly (no K/V expansion — the
+    # cache/HBM-bandwidth win; the kernel's index maps share KV blocks across
+    # the group). Only ring/ulysses still expect equal head counts and repeat
+    # KV up front (training-time only; same HBM cost as MHA KV would have).
     n_rep = cfg.n_heads // cfg.kv_heads
 
     def rep(a: jax.Array) -> jax.Array:
@@ -214,7 +215,7 @@ def _attention_block(
             kv_mask=kv_mask,
         )
     else:
-        grouped_ok = cfg.attention_impl == "naive"
+        grouped_ok = cfg.attention_impl in ("naive", "flash")
         out = multihead_attention(
             q,
             k if grouped_ok else rep(k),

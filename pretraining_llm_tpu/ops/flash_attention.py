@@ -116,7 +116,17 @@ def flash_attention(
     block_q: int = 0,
     block_kv: int = 0,
 ) -> jax.Array:
-    """Memory-efficient attention; Pallas kernel on TPU, blockwise JAX elsewhere."""
+    """Memory-efficient attention; Pallas kernel on TPU, blockwise JAX elsewhere.
+
+    q: (B, T, H, D); k, v: (B, T, G, D) with G | H. The Pallas kernel handles
+    GQA natively (query groups index shared KV blocks); the blockwise
+    fallback expands K/V — correctness-only, it runs on CPU/test paths.
+    """
+    gqa = k.shape[2] != q.shape[2]
+    if gqa and q.shape[2] % k.shape[2] != 0:
+        # Same fail-fast the Pallas path gives; without it the CPU fallback
+        # dies in an unrelated reshape.
+        raise ValueError(f"kv heads ({k.shape[2]}) must divide query heads ({q.shape[2]})")
     if _pallas_available():
         try:
             from pretraining_llm_tpu.ops.pallas_flash import pallas_flash_attention
@@ -126,4 +136,8 @@ def flash_attention(
             )
         except ImportError:
             pass  # kernel module not built yet; blockwise path is correct
+    if gqa:
+        n_rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
     return blockwise_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)

@@ -3,7 +3,7 @@
 Forward and backward kernels with a custom VJP. Design (vs the reference's
 fully-materialized (B,H,T,T) scores, /root/reference/src/models/attention.py:51-57):
 
-  - Grid (batch*heads, q_blocks, kv_blocks); the kv axis is innermost so the
+  - Grid (batch, head, q_blocks, kv_blocks); the kv axis is innermost so the
     fp32 accumulator/stats live in VMEM scratch across kv steps and the output
     block is written once on the last step (standard TPU revisiting pattern).
   - Online softmax: running row-max m and row-sum l; score blocks (bq, bk)
@@ -13,6 +13,11 @@ fully-materialized (B,H,T,T) scores, /root/reference/src/models/attention.py:51-
     no FLOPs).
   - QK^T and PV ride the MXU with fp32 accumulation (preferred_element_type);
     inputs stay bf16.
+  - **GQA native**: k/v may carry G = n_kv_heads < H heads. The grid's head
+    axis indexes QUERY heads; the k/v BlockSpec index maps divide down to the
+    shared KV head (h // n_rep) so no repeated K/V ever exists in HBM — the
+    bandwidth saving that motivates GQA. The dK/dV kernel grids over KV heads
+    and accumulates across the group's n_rep query heads in VMEM scratch.
   - Backward = two kernels (FA2): dQ gridded over q blocks, dK/dV gridded over
     kv blocks, both re-building P from the saved logsumexp; D = rowsum(dO*O)
     is precomputed in plain XLA.
@@ -64,8 +69,8 @@ def _block_sizes(t: int, block_q: int, block_kv: int) -> Tuple[int, int]:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causal, scale, bq, bk, nk):
-    i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # kv block
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block
 
     @pl.when(j == 0)
     def _init():
@@ -108,10 +113,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causa
 
 
 def _fwd(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool, block_q: int, block_kv: int,
-    interpret: bool,
+    q: jax.Array, k: jax.Array, v: jax.Array, h: int, g: int, *,
+    causal: bool, block_q: int, block_kv: int, interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
     bh, t, d = q.shape
+    b = bh // h
+    n_rep = h // g
     bq, bk = _block_sizes(t, block_q, block_kv)
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d**0.5)
@@ -121,18 +128,20 @@ def _fwd(
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
+            # GQA: the group's query heads share one KV head — index division,
+            # never a materialized repeat.
+            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
             # Stats ride in a trailing singleton lane dim: block (bq, 1) on
             # array (t, 1) satisfies Mosaic's (8, 128)-or-full-dim tiling rule
             # without the official kernel's 128-lane broadcast blowup.
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -156,8 +165,8 @@ def _fwd(
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, causal, scale, bq, bk, nk
 ):
-    i = pl.program_id(1)
-    j = pl.program_id(2)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
@@ -197,12 +206,13 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-    *, causal, scale, bq, bk, nq
+    *, causal, scale, bq, bk, nq, n_inner
 ):
-    j = pl.program_id(1)  # kv block (outer)
-    i = pl.program_id(2)  # q block (inner)
+    j = pl.program_id(2)  # kv block (outer)
+    ri = pl.program_id(3)  # inner: (q head within group) * nq + q block
+    i = ri % nq
 
-    @pl.when(i == 0)
+    @pl.when(ri == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -239,18 +249,20 @@ def _bwd_dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(ri == n_inner - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd(
-    causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, g
+    h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     q, k, v, o, lse = residuals
-    do = g
+    do = grad
     bh, t, d = q.shape
+    b = bh // h
+    n_rep = h // g
     bq, bk = _block_sizes(t, block_q, block_kv)
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d**0.5)
@@ -259,39 +271,48 @@ def _bwd(
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk),
-        grid=(bh, nq, nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
+            pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # delta
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dK/dV: grid over KV heads; the inner axis walks the group's n_rep query
+    # heads x nq q-blocks, accumulating into one (bk, d) scratch per kv block.
+    n_inner = n_rep * nq
+
+    def q_row(bb, hh, j, ri):
+        return bb * h + hh * n_rep + ri // nq
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq),
-        grid=(bh, nk, nq),
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq, n_inner=n_inner
+        ),
+        grid=(b, g, nk, n_inner),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, bq, d), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b * g, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * g, t, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -307,19 +328,19 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_kv, interpret):
-    o, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, h, g, causal, block_q, block_kv, interpret):
+    o, _ = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
-    o, lse = _fwd(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
+def _flash_fwd(q, k, v, h, g, causal, block_q, block_kv, interpret):
+    o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_kv, interpret, residuals, g):
-    return _bwd(causal, block_q, block_kv, interpret, residuals, g)
+def _flash_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):
+    return _bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -335,7 +356,9 @@ def pallas_flash_attention(
     block_kv: int = 0,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Flash attention. q, k, v: (B, T, H, Dh) -> (B, T, H, Dh).
+    """Flash attention. q: (B, T, H, Dh); k, v: (B, T, G, Dh) with G | H
+    (grouped-query attention — G < H never materializes repeated K/V).
+    Returns (B, T, H, Dh).
 
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere
     (slow — tests only).
@@ -343,6 +366,9 @@ def pallas_flash_attention(
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     b, t, h, d = q.shape
+    g = k.shape[2]
+    if h % g != 0:
+        raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
     qf, kf, vf = _heads_first(q), _heads_first(k), _heads_first(v)
-    of = _flash(qf, kf, vf, causal, block_q, block_kv, interpret)
+    of = _flash(qf, kf, vf, h, g, causal, block_q, block_kv, interpret)
     return _heads_last(of, b, h)
