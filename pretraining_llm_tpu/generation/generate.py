@@ -31,51 +31,73 @@ from pretraining_llm_tpu.models import transformer
 from pretraining_llm_tpu.generation.sampling import sample_logits
 
 
+def _bucket_len(prompt_len: int, ctx: int, max_new_tokens: int) -> int:
+    """Pad target for the prompt: next power of two (>=16), capped so the
+    padded prompt + generation still fits the context. Prompt LENGTH is a
+    traced value — only the bucket is a compile key, so all prompts in a
+    bucket share one executable instead of one compile per length."""
+    b = 16
+    while b < prompt_len:
+        b *= 2
+    return max(prompt_len, min(b, ctx - max_new_tokens))
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "prompt_len", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p", "mesh"),
 )
 def _generate_jit(
     params: Any,
-    prompt: jax.Array,  # (B, P) padded prompt
-    prompt_len: int,
+    prompt: jax.Array,  # (B, P_bucket) zero-padded prompt
+    prompt_len: jax.Array,  # () int32 — true length, traced
     key: jax.Array,
     cfg: ModelConfig,
     max_new_tokens: int,
     temperature: float,
     top_k: Optional[int],
     top_p: Optional[float],
+    mesh: Any = None,
 ) -> jax.Array:
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
     b = prompt.shape[0]
-    total = prompt_len + max_new_tokens
-    cache = transformer.make_kv_cache(cfg, b, total)
+    total = prompt.shape[1] + max_new_tokens
+    with activation_mesh(mesh):
+        cache = transformer.make_kv_cache(cfg, b, total)
 
-    # Prefill: one forward over the whole prompt.
-    logits, cache = transformer.forward(
-        params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
-    )
-    key, sub = jax.random.split(key)
-    next_tok = sample_logits(
-        logits[:, prompt_len - 1], sub, temperature=temperature, top_k=top_k, top_p=top_p
-    )
-
-    def decode_step(carry, _):
-        cache, tok, key, index = carry
+        # Prefill: one forward over the whole padded prompt. Causality keeps
+        # pad positions (>= prompt_len) invisible to real ones, and each pad
+        # slot's garbage K/V is overwritten by the decoded token that lands
+        # there before the kv_mask ever exposes it.
         logits, cache = transformer.forward(
-            params, tok[:, None], cfg, kv_cache=cache, cache_index=index
+            params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
         )
         key, sub = jax.random.split(key)
-        nxt = sample_logits(
-            logits[:, 0], sub, temperature=temperature, top_k=top_k, top_p=top_p
+        idx = jnp.broadcast_to(
+            (prompt_len - 1).astype(jnp.int32), (b, 1, logits.shape[-1])
         )
-        return (cache, nxt, key, index + 1), tok
+        last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+        next_tok = sample_logits(
+            last, sub, temperature=temperature, top_k=top_k, top_p=top_p
+        )
 
-    (_, _, _, _), toks = jax.lax.scan(
-        decode_step,
-        (cache, next_tok, key, jnp.int32(prompt_len)),
-        None,
-        length=max_new_tokens,
-    )
+        def decode_step(carry, _):
+            cache, tok, key, index = carry
+            logits, cache = transformer.forward(
+                params, tok[:, None], cfg, kv_cache=cache, cache_index=index
+            )
+            key, sub = jax.random.split(key)
+            nxt = sample_logits(
+                logits[:, 0], sub, temperature=temperature, top_k=top_k, top_p=top_p
+            )
+            return (cache, nxt, key, index + 1), tok
+
+        (_, _, _, _), toks = jax.lax.scan(
+            decode_step,
+            (cache, next_tok, key, prompt_len.astype(jnp.int32)),
+            None,
+            length=max_new_tokens,
+        )
     # Each step emits its carry-in token, so toks == the max_new_tokens
     # sampled ids in order (the final carry token is the unused n+1-th).
     return toks.T
@@ -91,11 +113,19 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    mesh: Any = None,
 ) -> jax.Array:
     """Generate continuations. prompt_tokens: (B, P) or (P,) int32.
 
     Returns (B, max_new_tokens) of sampled ids. The whole prompt+generation
     must fit the model context (the KV cache is position-table bound).
+
+    Prompts are zero-padded to a power-of-two bucket, so XLA compiles once
+    per (bucket, max_new_tokens, batch) — not once per prompt length.
+
+    ``mesh``: optional jax.sharding.Mesh for sharded decode of models too big
+    for one chip — pass params already placed with
+    `shard_params_for_inference`; activations follow the param shardings.
     """
     prompt = jnp.atleast_2d(jnp.asarray(prompt_tokens, jnp.int32))
     prompt_len = int(prompt.shape[1])
@@ -104,9 +134,21 @@ def generate(
             f"prompt({prompt_len}) + max_new_tokens({max_new_tokens}) exceeds "
             f"context_length={cfg.context_length}"
         )
+    bucket = _bucket_len(prompt_len, cfg.context_length, max_new_tokens)
+    if bucket > prompt_len:
+        prompt = jnp.pad(prompt, ((0, 0), (0, bucket - prompt_len)))
     return _generate_jit(
-        params, prompt, prompt_len, key, cfg, max_new_tokens, temperature, top_k, top_p
+        params, prompt, jnp.int32(prompt_len), key, cfg, max_new_tokens,
+        temperature, top_k, top_p, mesh,
     )
+
+
+def shard_params_for_inference(params: Any, mesh: Any) -> Any:
+    """Place params on a mesh with the training partition rules (TP/FSDP) so
+    `generate(..., mesh=mesh)` decodes models that exceed one chip's HBM."""
+    from pretraining_llm_tpu.parallel.sharding import named_sharding_tree, param_pspec_tree
+
+    return jax.device_put(params, named_sharding_tree(mesh, param_pspec_tree(params)))
 
 
 # ---------------------------------------------------------------------------

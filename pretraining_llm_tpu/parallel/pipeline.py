@@ -36,6 +36,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 BlockFn = Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]
 
 
+def schedule_ticks(n_micro: int, n_stages: int) -> int:
+    """Ticks the schedule runs: n_micro + n_stages - 1, the GPipe minimum.
+
+    Bubble fraction = (n_stages - 1) / ticks — identical to 1F1B's (1F1B's
+    win over GPipe is peak activation memory, ~n_stages instead of n_micro
+    microbatches in flight, not bubble; here activation memory is governed by
+    the remat policy on the stage body instead). Raise
+    pipeline_microbatches to shrink the bubble.
+    """
+    return n_micro + n_stages - 1
+
+
+def bubble_fraction(n_micro: int, n_stages: int) -> float:
+    return (n_stages - 1) / schedule_ticks(n_micro, n_stages)
+
+
 def pipeline_apply(
     blocks: Any,
     x: jax.Array,
@@ -67,6 +83,11 @@ def pipeline_apply(
             f"global batch {b} over {batch_shards} data shards gives a local "
             f"batch of {b // batch_shards if b % batch_shards == 0 else b / batch_shards}, "
             f"not divisible by pipeline_microbatches={n_micro}"
+        )
+    if x.shape[1] % n_stages != 0:
+        raise ValueError(
+            f"sequence length {x.shape[1]} must divide by n_stages="
+            f"{n_stages} (the output reduce-scatter slices the sequence dim)"
         )
 
     body = block_fn
@@ -125,13 +146,16 @@ def pipeline_apply(
                 jnp.zeros((), jnp.float32),
             )
             (_, out_buf, aux_sum), _ = jax.lax.scan(
-                tick, init, jnp.arange(n_micro + n_stages - 1)
+                tick, init, jnp.arange(schedule_ticks(n_micro, n_stages))
             )
 
         out = out_buf.reshape(bl, *x_local.shape[1:])
-        # Broadcast the last stage's result (and its aux) to every pipe rank.
-        is_last = (rank == n_stages - 1).astype(out.dtype)
-        out = jax.lax.psum(out * is_last, pipe_axis)
+        # Return routing: out_buf is zeros on every rank but the last, so a
+        # reduce-scatter over 'pipe' hands each rank its 1/n_stages slice of
+        # the sequence dim — half the bandwidth of the old full-activation
+        # psum broadcast, and the final-norm/lm-head/CE downstream now runs
+        # seq-sharded over the pipe axis instead of replicated on it.
+        out = jax.lax.psum_scatter(out, pipe_axis, scatter_dimension=1, tiled=True)
         # Aux statistics are per (data shard x microbatch) group; average over
         # microbatches AND the batch axes so the scalar is well-defined
         # (replicated) everywhere.
@@ -141,10 +165,11 @@ def pipeline_apply(
 
     blocks_spec = jax.tree.map(lambda _: P(pipe_axis), blocks)
     x_spec = P(batch_axes)
+    out_spec = P(batch_axes, pipe_axis)
     return jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(blocks_spec, x_spec),
-        out_specs=(x_spec, P()),
+        out_specs=(out_spec, P()),
         check_vma=False,
     )(blocks, x)

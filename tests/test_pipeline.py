@@ -154,3 +154,16 @@ def test_pipeline_with_moe_aux(mesh_pipe4):
     # group is a single sequence (B=4 over 2 data shards x 2 microbatches).
     per_seq = [float(ref(params, tokens[i : i + 1])[2]) for i in range(4)]
     np.testing.assert_allclose(float(aux), np.mean(per_seq), rtol=1e-4)
+
+
+def test_schedule_is_minimal_gpipe_and_bubble_shrinks_with_microbatches():
+    """The tick loop runs exactly n_micro + n_stages - 1 iterations (no dead
+    ticks), so bubble fraction is the GPipe/1F1B minimum for the microbatch
+    count and decays toward 0 as microbatches grow."""
+    from pretraining_llm_tpu.parallel.pipeline import bubble_fraction, schedule_ticks
+
+    assert schedule_ticks(n_micro=4, n_stages=2) == 5
+    assert schedule_ticks(n_micro=1, n_stages=1) == 1
+    assert bubble_fraction(4, 2) == 1 / 5
+    assert bubble_fraction(32, 2) == 1 / 33
+    assert bubble_fraction(8, 4) < bubble_fraction(4, 4) < bubble_fraction(2, 4)
