@@ -47,6 +47,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn"]
     )
+    parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
         "--timeout-budget",
         type=float,
@@ -85,6 +86,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         model = dataclasses.replace(model, attention_impl=args.attention)
     elif model.attention_impl == "ring":
         model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
+    if args.unroll:
+        model = dataclasses.replace(model, scan_unroll=args.unroll)
     if args.remat:
         model = dataclasses.replace(model, remat=args.remat)
     elif model.remat == "none":
@@ -210,6 +213,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
             cmd += ["--attention", args.attention]
         if args.remat:
             cmd += ["--remat", args.remat]
+        if args.unroll:
+            cmd += ["--unroll", str(args.unroll)]
         try:
             proc = subprocess.run(
                 cmd,

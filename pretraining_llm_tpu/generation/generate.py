@@ -134,7 +134,14 @@ def generate(
             f"prompt({prompt_len}) + max_new_tokens({max_new_tokens}) exceeds "
             f"context_length={cfg.context_length}"
         )
-    bucket = _bucket_len(prompt_len, cfg.context_length, max_new_tokens)
+    # MoE prefill routes with a capacity proportional to the token count and
+    # pad tokens would compete for expert slots, perturbing real tokens'
+    # hidden states — bucketing is for dense models only.
+    bucket = (
+        prompt_len
+        if cfg.n_experts
+        else _bucket_len(prompt_len, cfg.context_length, max_new_tokens)
+    )
     if bucket > prompt_len:
         prompt = jnp.pad(prompt, ((0, 0), (0, bucket - prompt_len)))
     return _generate_jit(

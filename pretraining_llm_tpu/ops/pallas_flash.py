@@ -255,6 +255,67 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal, scale, n_rep
+):
+    """Single-block backward (t <= one block): dQ, dK, dV in ONE pass.
+
+    The two-kernel FA2 split exists because dQ accumulates over kv blocks
+    while dK/dV accumulate over q blocks — with one block each there is
+    nothing to accumulate across, so S and P are computed once (5 matmuls vs
+    the split's 7) and q/k/v/do are read from HBM once instead of twice.
+    GQA: grid is (batch, kv_head, n_rep); dk/dv accumulate the group's query
+    heads in scratch across the innermost axis.
+    """
+    r = pl.program_id(2)  # query head within the kv group
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    tq, dd = q.shape
+    tk = k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+    dv_part = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dk_part = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(r == 0)
+    def _init():
+        dk_acc[:] = dk_part
+        dv_acc[:] = dv_part
+
+    @pl.when(r != 0)
+    def _accum():
+        dk_acc[:] += dk_part
+        dv_acc[:] += dv_part
+
+    @pl.when(r == n_rep - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _bwd(
     h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -268,6 +329,38 @@ def _bwd(
     scale = 1.0 / (d**0.5)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (bh, t, 1)
+
+    if nq == 1 and nk == 1:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel, causal=causal, scale=scale, n_rep=n_rep
+            ),
+            grid=(b, g, n_rep),
+            in_specs=[
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # q
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),  # k
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),  # v
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # do
+                pl.BlockSpec((1, t, 1), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # lse
+                pl.BlockSpec((1, t, 1), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                jax.ShapeDtypeStruct((b * g, t, d), k.dtype),
+                jax.ShapeDtypeStruct((b * g, t, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((t, d), jnp.float32),
+                pltpu.VMEM((t, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk),

@@ -34,6 +34,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()  # PLLM_PLATFORM=cpu runs the jax side off-TPU
+
 PARITY_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data", "parity")
 
 # Small GPT-2-shape model (standard mode: fused QKV, output projection, tied
@@ -140,6 +144,11 @@ def run_jax(args, model_cfg, train_path, val_path, init_npz):
         ),
         name="parity",
     )
+    # True-f32 matmuls: on TPU, jax's default "fastest" precision runs f32
+    # einsums as bf16 MXU passes — a real numeric difference vs the torch
+    # CPU baseline that compounds over steps. The parity bar measures
+    # framework math, not matmul rounding mode.
+    jax.config.update("jax_default_matmul_precision", "highest")
     state = ts.init_train_state(cfg, jax.random.key(0))
     # Persist the exact initial weights for the torch twin.
     flat = jax.tree_util.tree_flatten_with_path(state["params"])[0]
@@ -156,6 +165,10 @@ def run_jax(args, model_cfg, train_path, val_path, init_npz):
         train_path, BATCH, model_cfg.context_length, seed=DATA_SEED
     )
 
+    eval_step = jax.jit(
+        lambda p, x, y: transformer.loss_fn(p, x, y, model_cfg, include_aux=False)
+    )
+
     def eval_loss(params):
         ev = loader.get_batch_iterator(
             val_path, BATCH, model_cfg.context_length, seed=EVAL_SEED
@@ -163,11 +176,7 @@ def run_jax(args, model_cfg, train_path, val_path, init_npz):
         total = 0.0
         for _ in range(args.eval_iters):
             x, y = next(ev)
-            total += float(
-                transformer.loss_fn(
-                    params, jnp.asarray(x), jnp.asarray(y), model_cfg, include_aux=False
-                )
-            )
+            total += float(eval_step(params, jnp.asarray(x), jnp.asarray(y)))
         return total / args.eval_iters
 
     curve = []
@@ -305,7 +314,7 @@ def main():
     ap.add_argument("--steps", type=int, default=1500)
     ap.add_argument("--eval-iters", type=int, default=50)
     ap.add_argument("--log-every", type=int, default=100)
-    ap.add_argument("--skip-corpus", action="store_true")
+    ap.add_argument("--rebuild-corpus", action="store_true")
     ap.add_argument("--only", choices=["", "jax", "torch"], default="")
     args = ap.parse_args()
 
@@ -319,7 +328,10 @@ def main():
     init_npz = os.path.join(PARITY_DIR, "init.npz")
     results_path = os.path.join(PARITY_DIR, "results.json")
 
-    if not args.skip_corpus or not os.path.exists(train_bin):
+    # Rebuild only when missing (or forced): the harvest walks a LIVE
+    # filesystem, so an implicit rebuild between --only jax and --only torch
+    # could silently train the twins on different data.
+    if args.rebuild_corpus or not os.path.exists(train_bin):
         n = build_corpus(corpus)
         tokenize_corpus(corpus, train_bin, val_bin)
         print(f"corpus: {n/1e6:.2f} MB real text -> {train_bin}")

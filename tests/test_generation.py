@@ -164,3 +164,20 @@ def test_sharded_decode_matches_single_device(params, mesh8):
         generate(sharded, CFG, prompt, 5, jax.random.key(7), temperature=0.0, mesh=mesh8)
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_moe_generation_not_bucketed_and_matches_reference():
+    """Pad tokens would enter capacitated MoE routing and perturb real
+    tokens' outputs — MoE prompts must not be padded (and greedy decode must
+    match the uncached reference loop at an awkward prompt length)."""
+    cfg = dataclasses.replace(
+        CFG, n_experts=4, experts_per_token=2, expert_capacity_factor=1.25
+    )
+    params = transformer.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 17), 0, cfg.vocab_size)
+    got = np.asarray(generate(params, cfg, prompt, 6, jax.random.key(2), temperature=0.0))
+    seq = np.asarray(prompt)
+    for _ in range(6):
+        logits, _ = transformer.forward(params, jnp.asarray(seq), cfg)
+        seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+    np.testing.assert_array_equal(got, seq[:, 17:])

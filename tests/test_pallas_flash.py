@@ -115,3 +115,22 @@ def test_gqa_bf16_forward():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=2e-2, atol=2e-2
     )
+
+
+@pytest.mark.parametrize("g", [4, 2, 1])
+def test_fused_single_block_backward_matches_naive(g):
+    """t <= block triggers the fused dQ/dK/dV kernel (one pass, shared S/P)."""
+    q, k, v = _gqa_qkv(jax.random.key(11), t=64, h=4, g=g)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        # default blocks (1024) >= t=64 -> nq == nk == 1 -> fused kernel
+        return jnp.sum(pallas_flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    g_naive = jax.grad(loss_naive, (0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_naive, g_flash):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
