@@ -45,7 +45,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
     parser.add_argument(
-        "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn"]
+        "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
     )
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(

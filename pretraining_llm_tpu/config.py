@@ -25,7 +25,7 @@ _ACTIVATIONS = ("relu", "gelu", "swiglu")
 _NORMS = ("layernorm", "rmsnorm")
 _POS_EMBEDS = ("learned", "rope")
 _ATTN_IMPLS = ("naive", "flash", "ring", "ulysses")
-_REMAT_POLICIES = ("none", "full", "dots_saveable", "save_attn")
+_REMAT_POLICIES = ("none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big")
 
 
 @dataclass(frozen=True)
@@ -73,8 +73,9 @@ class ModelConfig:
     # Flash-attention block sizes (tuned for TPU MXU/VMEM; 0 = auto)
     flash_block_q: int = 0
     flash_block_kv: int = 0
-    # Rematerialization policy applied to each scanned block
-    remat: str = "none"  # none | full | dots_saveable | save_attn
+    # Rematerialization policy applied to each scanned block — see
+    # ops/remat.py for what each saves.
+    remat: str = "none"  # none | full | dots_saveable | save_attn | save_qkv_attn | save_big
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
     scan_unroll: int = 1

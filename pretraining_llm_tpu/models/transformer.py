@@ -30,9 +30,11 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from pretraining_llm_tpu.config import ModelConfig
 from pretraining_llm_tpu.models import layers, moe
+from pretraining_llm_tpu.ops import remat
 from pretraining_llm_tpu.ops.attention import multihead_attention
 from pretraining_llm_tpu.parallel.sharding import constrain, current_mesh
 
@@ -179,6 +181,13 @@ def _attention_block(
         q = layers.apply_rope(q, cos, sin, positions)
         k = layers.apply_rope(k, cos, sin, positions)
 
+    # Remat tags for the 'save_qkv_attn'/'save_big' policies: with post-RoPE
+    # q/k/v saved, the attention backward starts directly from its VJP inputs
+    # instead of recomputing LN1 + the QKV projection (+RoPE).
+    q = checkpoint_name(q, "qkv")
+    k = checkpoint_name(k, "qkv")
+    v = checkpoint_name(v, "qkv")
+
     # GQA: the naive grouped einsum and the Pallas flash kernel both attend
     # H query heads against G KV heads directly (no K/V expansion — the
     # cache/HBM-bandwidth win; the kernel's index maps share KV blocks across
@@ -228,8 +237,6 @@ def _attention_block(
 
     # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,
     # expensive-to-recompute) attention output, recompute everything else.
-    from jax.ad_checkpoint import checkpoint_name
-
     out = checkpoint_name(out, "attn_out")
 
     if cfg.use_output_proj:
@@ -268,6 +275,7 @@ def _mlp_block(
         if "b1" in mlp:
             hidden = hidden + mlp["b1"].astype(cdt)
         hidden = layers.activation_fn(cfg.activation, hidden)
+    hidden = checkpoint_name(hidden, "mlp_hidden")
     out = jnp.einsum(
         "btf,fd->btd", hidden, mlp["w2"].astype(cdt), preferred_element_type=jnp.float32
     ).astype(cdt)
@@ -362,18 +370,7 @@ def forward(
         x, new_kv, aux = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
         return (x, aux_sum + aux), new_kv
 
-    body = scan_body
-    if cfg.remat == "full":
-        body = jax.checkpoint(scan_body)
-    elif cfg.remat == "dots_saveable":
-        body = jax.checkpoint(
-            scan_body, policy=jax.checkpoint_policies.dots_saveable
-        )
-    elif cfg.remat == "save_attn":
-        body = jax.checkpoint(
-            scan_body,
-            policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
-        )
+    body = remat.checkpoint_wrap(scan_body, cfg.remat)
 
     mesh = current_mesh()
     use_pipeline = (

@@ -33,6 +33,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -179,7 +180,10 @@ def _bwd_dq_kernel(
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        # All matmuls take bf16 inputs with fp32 accumulation (MXU-native);
+        # only the elementwise dS math runs in fp32. Casting do/v up first
+        # would silently demote dp to a multi-pass fp32 matmul.
+        do = do_ref[0]
         lse = lse_ref[0]  # (bq, 1)
         delta = delta_ref[0]  # (bq, 1)
         s = jax.lax.dot_general(
@@ -191,8 +195,7 @@ def _bwd_dq_kernel(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
@@ -224,7 +227,8 @@ def _bwd_dkv_kernel(
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul inputs, fp32 accumulation (see _bwd_dq_kernel).
+        do = do_ref[0]
         lse = lse_ref[0]  # (bq, 1)
         delta = delta_ref[0]  # (bq, 1)
         s = jax.lax.dot_general(
@@ -240,8 +244,7 @@ def _bwd_dkv_kernel(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * scale  # (bq, bk)
         # dK += dS^T Q
@@ -272,7 +275,8 @@ def _bwd_fused_kernel(
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)
+    # bf16 matmul inputs, fp32 accumulation (see _bwd_dq_kernel).
+    do = do_ref[0]
     lse = lse_ref[0]
     delta = delta_ref[0]
     tq, dd = q.shape
@@ -286,8 +290,7 @@ def _bwd_fused_kernel(
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
-        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     ds = p * (dp - delta) * scale
     dq_ref[0] = jax.lax.dot_general(
@@ -319,7 +322,8 @@ def _bwd_fused_kernel(
 def _bwd(
     h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    q, k, v, o, lse = residuals
+    q, k, v, o, lse2 = residuals
+    lse = lse2[..., None]
     do = grad
     bh, t, d = q.shape
     b = bh // h
@@ -429,7 +433,16 @@ def _flash(q, k, v, h, g, causal, block_q, block_kv, interpret):
 
 def _flash_fwd(q, k, v, h, g, causal, block_q, block_kv, interpret):
     o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
-    return o, (q, k, v, o, lse)
+    # Remat tags: under the 'save_qkv_attn'/'save_big' policies the VJP
+    # residuals themselves are saved, so the backward never re-runs this
+    # kernel (plain 'save_attn' only tags the merged output downstream,
+    # which cannot reconstruct lse — the fwd kernel reruns there).
+    # lse is squeezed to 2-D for the residual: a trailing-singleton (bh, t, 1)
+    # buffer saved across the layer scan provokes pathological XLA layout
+    # handling (observed as a compile hang with these residuals saved).
+    o_res = checkpoint_name(o, "attn_o_res")
+    lse2 = checkpoint_name(lse[..., 0], "attn_lse")
+    return o, (q, k, v, o_res, lse2)
 
 
 def _flash_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):

@@ -1,0 +1,59 @@
+"""Rematerialization policies: one name -> jax.checkpoint wrapper mapping.
+
+Single source of truth for what each `ModelConfig.remat` value saves, shared
+by the scanned-layer path (models.transformer.forward) and the pipelined path
+(parallel.pipeline.pipeline_apply) so the same config string always means the
+same backward-pass schedule.
+
+Policies (cheapest memory -> cheapest recompute):
+  - "full":          save nothing; backward re-runs the whole block.
+  - "dots_saveable": save every matmul output (XLA default-ish middle ground).
+  - "save_attn":     save only the merged attention output ("attn_out" tag);
+                     backward re-runs QKV projection + the flash forward.
+  - "save_qkv_attn": additionally save post-RoPE q/k/v ("qkv") and the flash
+                     VJP residuals ("attn_o_res", "attn_lse") — the attention
+                     backward starts directly from its residuals, so neither
+                     the QKV projection nor the flash forward kernel reruns.
+  - "save_big":      save_qkv_attn + the MLP hidden ("mlp_hidden"); recompute
+                     is just LN/residual elementwise math.
+  - "none":          no checkpointing (autodiff saves everything it needs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+# Tag names referenced by checkpoint_name() calls in models/transformer.py,
+# models/moe.py and ops/pallas_flash.py. Keep these lists in sync with the
+# tag sites — a policy naming a tag that no longer exists silently saves
+# nothing for it.
+_SAVE_ATTN = ("attn_out",)
+_SAVE_QKV_ATTN = ("qkv", "attn_o_res", "attn_lse")
+_SAVE_BIG = _SAVE_QKV_ATTN + ("mlp_hidden",)
+
+POLICIES = ("none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big")
+
+
+def checkpoint_wrap(fn: Callable, remat: str) -> Callable:
+    """Wrap a per-layer body with the checkpoint policy named by ``remat``."""
+    if remat == "none":
+        return fn
+    if remat == "full":
+        return jax.checkpoint(fn)
+    if remat == "dots_saveable":
+        return jax.checkpoint(fn, policy=jax.checkpoint_policies.dots_saveable)
+    if remat == "save_attn":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names(*_SAVE_ATTN)
+        )
+    if remat == "save_qkv_attn":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names(*_SAVE_QKV_ATTN)
+        )
+    if remat == "save_big":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names(*_SAVE_BIG)
+        )
+    raise ValueError(f"unknown remat policy {remat!r}")

@@ -90,15 +90,9 @@ def pipeline_apply(
             f"{n_stages} (the output reduce-scatter slices the sequence dim)"
         )
 
-    body = block_fn
-    if remat == "full":
-        body = jax.checkpoint(block_fn)
-    elif remat == "dots_saveable":
-        body = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.dots_saveable)
-    elif remat == "save_attn":
-        body = jax.checkpoint(
-            block_fn, policy=jax.checkpoint_policies.save_only_these_names("attn_out")
-        )
+    from pretraining_llm_tpu.ops.remat import checkpoint_wrap
+
+    body = checkpoint_wrap(block_fn, remat)
 
     def local(blocks_local: Any, x_local: jax.Array):
         # blocks_local: leading dim n_layers/n_stages; x_local: (b_local, T, D)

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Capture a device trace of the train step and print the HLO-op time table.
+
+Runs a few steps under jax.profiler.trace, then parses the captured
+xplane.pb with the in-image xprof converter (no TensorBoard UI needed,
+the machine is air-gapped) and prints the top ops by self time — the
+ground truth for where the step time actually goes.
+
+Usage:
+  python scripts/profile_capture.py --preset gpt2-124m --batch 24 --remat save_attn
+  python scripts/profile_capture.py --tool framework_op_stats --top 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt2-124m")
+    ap.add_argument("--batch", type=int, default=24)
+    ap.add_argument("--remat", default="")
+    ap.add_argument("--attention", default="")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--out", default="/tmp/pllm_trace")
+    ap.add_argument("--tool", default="hlo_stats")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--parse-only", action="store_true")
+    args = ap.parse_args()
+
+    if not args.parse_only:
+        import jax
+        import jax.numpy as jnp
+
+        from pretraining_llm_tpu.config import get_preset
+        from pretraining_llm_tpu.data import loader
+        from pretraining_llm_tpu.training import train_step as ts
+
+        cfg = get_preset(args.preset)
+        model = cfg.model
+        if args.attention:
+            model = dataclasses.replace(model, attention_impl=args.attention)
+        elif model.attention_impl == "ring":
+            model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
+        if args.remat:
+            model = dataclasses.replace(model, remat=args.remat)
+        cfg = cfg.replace(
+            model=model, train=dataclasses.replace(cfg.train, batch_size=args.batch)
+        )
+        state = ts.init_train_state(cfg, jax.random.key(0))
+        step = ts.build_train_step(cfg, None)
+        it = loader.synthetic_iterator(model.vocab_size, model.context_length, args.batch, seed=0)
+        x, y = next(it)
+        batch = (jnp.asarray(x), jnp.asarray(y))
+        # Warm (compile) outside the trace window.
+        state, m = step(state, batch)
+        float(jax.device_get(m["loss"]))
+        with jax.profiler.trace(args.out):
+            for _ in range(args.steps):
+                state, m = step(state, batch)
+            float(jax.device_get(m["loss"]))
+
+    planes = sorted(
+        glob.glob(os.path.join(args.out, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not planes:
+        print(json.dumps({"error": f"no xplane.pb under {args.out}"}))
+        return
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data([planes[-1]], args.tool, {})
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    rows = _extract_rows(data, args.tool)
+    if rows is None:
+        print(data[:8000])
+        return
+    for r in rows[: args.top]:
+        print(r)
+
+
+def _extract_rows(data: str, tool: str):
+    """hlo_stats/framework_op_stats come back as gviz JSON-ish or CSV."""
+    try:
+        obj = json.loads(data)
+    except (json.JSONDecodeError, ValueError):
+        lines = data.splitlines()
+        return lines if lines else None
+    # gviz DataTable: {"cols": [...], "rows": [{"c": [{"v": ...}, ...]}]}
+    if isinstance(obj, dict) and "rows" in obj and "cols" in obj:
+        labels = [c.get("label") or c.get("id") for c in obj["cols"]]
+        out = ["\t".join(str(x) for x in labels)]
+        for row in obj["rows"]:
+            out.append("\t".join(str(c.get("v") if c else "") for c in row["c"]))
+        return out
+    return None
+
+
+if __name__ == "__main__":
+    main()

@@ -134,3 +134,26 @@ def test_fused_single_block_backward_matches_naive(g):
     for a, b in zip(g_naive, g_flash):
         assert a.shape == b.shape
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("policy_names", [("attn_o_res", "attn_lse"), ()])
+def test_remat_saved_residuals_match_recompute(policy_names):
+    """The 'save_qkv_attn'/'save_big' policies save the kernel's VJP residuals
+    (o + squeezed lse, tagged in _flash_fwd) instead of re-running the forward
+    in the backward. Gradients must be identical either way — this pins the
+    tag names and the lse squeeze/re-expand pair in _flash_fwd/_bwd."""
+    q, k, v = _qkv(jax.random.key(4), t=32)
+
+    def loss(q, k, v):
+        out = pallas_flash_attention(
+            q, k, v, causal=True, block_q=16, block_kv=16, interpret=True
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_plain = jax.grad(loss, (0, 1, 2))(q, k, v)
+    ckpt = jax.checkpoint(
+        loss, policy=jax.checkpoint_policies.save_only_these_names(*policy_names)
+    )
+    g_ckpt = jax.jit(jax.grad(ckpt, (0, 1, 2)))(q, k, v)
+    for a, b in zip(g_plain, g_ckpt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
