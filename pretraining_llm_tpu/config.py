@@ -96,6 +96,10 @@ class ModelConfig:
     # mesh axis, GPipe microbatch schedule via ppermute. 1 = off.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    # Virtual stages per rank (Megatron-style interleaving): each rank hosts
+    # this many round-robin depth chunks, shrinking the pipeline bubble by
+    # the same factor. 1 = plain GPipe. Requires microbatches >= stages.
+    pipeline_interleave: int = 1
 
     def __post_init__(self) -> None:
         if self.activation not in _ACTIVATIONS:
@@ -146,6 +150,26 @@ class ModelConfig:
             )
         if self.pipeline_microbatches < 1:
             raise ValueError("pipeline_microbatches must be >= 1")
+        if self.pipeline_interleave < 1 or (
+            self.n_layers % (self.pipeline_stages * self.pipeline_interleave) != 0
+        ):
+            raise ValueError(
+                f"pipeline_interleave={self.pipeline_interleave} x "
+                f"pipeline_stages={self.pipeline_stages} must divide "
+                f"n_layers={self.n_layers}"
+            )
+        if self.pipeline_interleave > 1:
+            if self.pipeline_stages == 1:
+                raise ValueError(
+                    "pipeline_interleave > 1 does nothing without "
+                    "pipeline_stages > 1"
+                )
+            if self.pipeline_microbatches < self.pipeline_stages:
+                raise ValueError(
+                    "pipeline_interleave > 1 requires pipeline_microbatches >= "
+                    f"pipeline_stages ({self.pipeline_microbatches} < "
+                    f"{self.pipeline_stages})"
+                )
         if self.pipeline_stages > 1 and (
             self.attention_impl in ("ring", "ulysses") or self.sequence_parallel
         ):

@@ -394,6 +394,7 @@ def forward(
         x, aux_total = pipeline.pipeline_apply(
             params["blocks"], x, mesh, pipe_block,
             n_micro=cfg.pipeline_microbatches, remat=cfg.remat,
+            interleave=cfg.pipeline_interleave,
         )
         new_cache = None
     elif kv_cache is None:

@@ -13,6 +13,10 @@ threads, no schedule executor; the whole pipeline is ONE jitted SPMD program:
     the next microbatch, every stage applies its local layers, and activations
     hop to the next stage with a single `jax.lax.ppermute` (one ICI neighbor
     hop). n_micro + n_stages - 1 ticks drain the pipe.
+  - `interleave=V>1` upgrades this to the Megatron interleaved (virtual
+    stage) schedule: each rank hosts V round-robin depth chunks, microbatches
+    lap the ring V times (the ppermute gains a wrap edge), and the bubble
+    fraction drops V-fold to (S-1)/(V*n_micro + S-1).
   - The backward pass needs no schedule of its own: `jax.grad` transposes the
     whole loop (ppermute transposes to the reverse hop), so the 1F1B-style
     reverse traffic falls out of autodiff.
@@ -31,25 +35,33 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BlockFn = Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]
 
 
-def schedule_ticks(n_micro: int, n_stages: int) -> int:
-    """Ticks the schedule runs: n_micro + n_stages - 1, the GPipe minimum.
+def schedule_ticks(n_micro: int, n_stages: int, interleave: int = 1) -> int:
+    """Ticks the schedule runs: interleave*n_micro + n_stages - 1.
 
-    Bubble fraction = (n_stages - 1) / ticks — identical to 1F1B's (1F1B's
+    With interleave=1 this is the GPipe minimum, n_micro + n_stages - 1, and
+    bubble fraction = (n_stages - 1) / ticks — identical to 1F1B's (1F1B's
     win over GPipe is peak activation memory, ~n_stages instead of n_micro
     microbatches in flight, not bubble; here activation memory is governed by
-    the remat policy on the stage body instead). Raise
-    pipeline_microbatches to shrink the bubble.
+    the remat policy on the stage body instead).
+
+    With interleave=V>1 (Megatron-style interleaved virtual stages: each rank
+    hosts V depth chunks of n_layers/(V*n_stages) layers, so a microbatch
+    laps the ring V times), a tick costs 1/V of a GPipe tick — the fill/drain
+    bubble is paid in chunk-times, shrinking the bubble fraction V-fold:
+    (S-1)/(V*n_micro + S - 1). Raise pipeline_microbatches and/or
+    pipeline_interleave to shrink the bubble.
     """
-    return n_micro + n_stages - 1
+    return interleave * n_micro + n_stages - 1
 
 
-def bubble_fraction(n_micro: int, n_stages: int) -> float:
-    return (n_stages - 1) / schedule_ticks(n_micro, n_stages)
+def bubble_fraction(n_micro: int, n_stages: int, interleave: int = 1) -> float:
+    return (n_stages - 1) / schedule_ticks(n_micro, n_stages, interleave)
 
 
 def pipeline_apply(
@@ -60,6 +72,7 @@ def pipeline_apply(
     *,
     n_micro: int,
     remat: str = "none",
+    interleave: int = 1,
     pipe_axis: str = "pipe",
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
 ) -> Tuple[jax.Array, jax.Array]:
@@ -68,6 +81,13 @@ def pipeline_apply(
     blocks: stacked block params, leading dim n_layers (sharded over 'pipe').
     x: (B, T, D) embedded activations; B divides into n_micro microbatches.
     block_fn: (block_params, x) -> (x, aux) for ONE layer.
+    interleave: virtual stages per rank (V). V=1 is plain GPipe. V>1 splits
+    each rank's layers into V depth chunks laid out round-robin (rank r hosts
+    chunks r, S+r, 2S+r, ...), so every microbatch laps the ring V times and
+    the fill/drain bubble shrinks V-fold (see schedule_ticks). Costs one
+    static permutation of the stacked layer dim per step (a cross-stage
+    collective copy — at production scale you'd bake the permuted layout into
+    the train state instead) plus V x the activation hop volume.
     Returns (y (B, T, D), aux_sum) — aux summed over layers, averaged over
     microbatches (matching the non-pipelined scan semantics).
     """
@@ -89,58 +109,123 @@ def pipeline_apply(
             f"sequence length {x.shape[1]} must divide by n_stages="
             f"{n_stages} (the output reduce-scatter slices the sequence dim)"
         )
+    if interleave > 1 and n_micro < n_stages:
+        # Feasibility of the breadth-first interleaved schedule: microbatch m
+        # finishes lap v at tick v*n_micro + m + n_stages - 1 and must be back
+        # at rank 0 by tick (v+1)*n_micro + m, i.e. n_micro >= n_stages.
+        raise ValueError(
+            f"pipeline_interleave={interleave} needs pipeline_microbatches "
+            f">= pipeline_stages ({n_micro} < {n_stages})"
+        )
 
     from pretraining_llm_tpu.ops.remat import checkpoint_wrap
 
     body = checkpoint_wrap(block_fn, remat)
+    n_layers = jax.tree.leaves(blocks)[0].shape[0]
+    lpc = n_layers // (n_stages * interleave)  # layers per chunk
+
+    if interleave > 1:
+        # Chunk j = v*S + r (depth order) must live on rank r. Permute the
+        # stacked dim to rank-major (r, v, k) order so the contiguous
+        # P('pipe') shards hold exactly each rank's V chunks.
+        perm_idx = (
+            np.arange(n_layers)
+            .reshape(interleave, n_stages, lpc)
+            .transpose(1, 0, 2)
+            .reshape(-1)
+        )
+        spec = NamedSharding(mesh, P(pipe_axis))
+        blocks = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a[perm_idx], spec), blocks
+        )
 
     def local(blocks_local: Any, x_local: jax.Array):
-        # blocks_local: leading dim n_layers/n_stages; x_local: (b_local, T, D)
+        # blocks_local: leading dim n_layers/n_stages (= V*lpc, chunk-ordered
+        # when interleave>1); x_local: (b_local, T, D)
         from pretraining_llm_tpu.parallel.sharding import activation_mesh
 
         rank = jax.lax.axis_index(pipe_axis)
         bl = x_local.shape[0]
         mb = bl // n_micro
         mbs = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        chunks = jax.tree.map(
+            lambda a: a.reshape(interleave, lpc, *a.shape[1:]), blocks_local
+        )
 
-        def apply_stage(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        def apply_chunk(chunk: Any, a: jax.Array) -> Tuple[jax.Array, jax.Array]:
             def layer(carry, blk):
                 h, aux = carry
                 h, aux_i = body(blk, h)
                 return (h, aux + aux_i), None
 
-            (y, aux), _ = jax.lax.scan(layer, (a, jnp.zeros((), jnp.float32)), blocks_local)
+            (y, aux), _ = jax.lax.scan(layer, (a, jnp.zeros((), jnp.float32)), chunk)
             return y, aux
 
-        # Stage s sends to s+1; stage 0 receives zeros (replaced by injection).
-        perm = [(i, i + 1) for i in range(n_stages - 1)]
+        if interleave > 1:
+            # Ring: rank S-1 wraps around to feed rank 0 the next lap.
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        else:
+            # Chain: stage s sends to s+1; stage 0 receives only injections.
+            perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        n_items = interleave * n_micro
 
         def tick(carry, t):
-            recv, out_buf, aux_sum = carry
-            inject = jax.lax.dynamic_index_in_dim(
-                mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            recv, wrap_buf, out_buf, aux_sum = carry
+            # Work item at this rank this tick: u-th of the m-major (m, v)
+            # stream; m = microbatch, v = lap (chunk index on this rank).
+            u = t - rank
+            m = jnp.clip(jnp.mod(u, n_micro), 0, n_micro - 1)
+            v = jnp.clip(u // n_micro, 0, interleave - 1)
+            valid = (u >= 0) & (u < n_items)
+
+            if interleave > 1:
+                # Rank 0 banks the wrapped activation that arrived this tick:
+                # rank S-1's output from tick t-1, item u_w = t - S. It is
+                # needed at tick (v_w+1)*n_micro + m_w >= its arrival (the
+                # n_micro >= S check above), so bank-then-read is safe.
+                u_w = t - n_stages
+                m_w = jnp.clip(jnp.mod(u_w, n_micro), 0, n_micro - 1)
+                bank = (rank == 0) & (u_w >= 0) & (u_w // n_micro < interleave - 1)
+                wrap_buf = jnp.where(
+                    bank,
+                    jax.lax.dynamic_update_index_in_dim(wrap_buf, recv, m_w, 0),
+                    wrap_buf,
+                )
+                inject = jax.lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
+                lapped = jax.lax.dynamic_index_in_dim(wrap_buf, m, 0, keepdims=False)
+                first = jnp.where(v == 0, inject, lapped)
+            else:
+                first = jax.lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
+            a = jnp.where(rank == 0, first, recv)
+
+            chunk = jax.tree.map(
+                lambda c: jax.lax.dynamic_index_in_dim(c, v, 0, keepdims=False), chunks
             )
-            a = jnp.where(rank == 0, inject, recv)
-            y, aux = apply_stage(a)
-            # This rank computed microbatch (t - rank): only count real work.
-            valid = ((t - rank) >= 0) & ((t - rank) < n_micro)
+            y, aux = apply_chunk(chunk, a)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
-            # Last stage banks its finished microbatch.
-            slot = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-            banked = jax.lax.dynamic_update_index_in_dim(out_buf, y, slot, 0)
-            out_buf = jnp.where((rank == n_stages - 1) & (t >= n_stages - 1), banked, out_buf)
+            # Last stage banks each microbatch's final lap.
+            done = (rank == n_stages - 1) & valid & (v == interleave - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(out_buf, y, m, 0)
+            out_buf = jnp.where(done, banked, out_buf)
             recv = jax.lax.ppermute(y, pipe_axis, perm)
-            return (recv, out_buf, aux_sum), None
+            return (recv, wrap_buf, out_buf, aux_sum), None
 
         # GSPMD sharding constraints are meaningless inside the manual region.
         with activation_mesh(None):
+            wrap0 = (
+                jnp.zeros_like(mbs)
+                if interleave > 1
+                else jnp.zeros((0,), x_local.dtype)
+            )
             init = (
                 jnp.zeros((mb, *x_local.shape[1:]), x_local.dtype),
+                wrap0,
                 jnp.zeros_like(mbs),
                 jnp.zeros((), jnp.float32),
             )
-            (_, out_buf, aux_sum), _ = jax.lax.scan(
-                tick, init, jnp.arange(schedule_ticks(n_micro, n_stages))
+            (_, _, out_buf, aux_sum), _ = jax.lax.scan(
+                tick, init, jnp.arange(schedule_ticks(n_micro, n_stages, interleave))
             )
 
         out = out_buf.reshape(bl, *x_local.shape[1:])
