@@ -1,0 +1,77 @@
+"""Shared loader for the C++ runtime libraries under native/.
+
+One code path for auto-building (`make <target>.so`) and ctypes-loading every
+native extension, used by native_batcher.py and native_bpe.py. Build is
+serialized across *processes* with an fcntl file lock — preprocess fans out
+a multiprocessing Pool, and without the lock every fresh worker would race
+`make` in the same directory and could dlopen a half-written library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Optional
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}
+_cache_lock = threading.Lock()
+
+
+def load_native_lib(
+    so_name: str,
+    configure: Callable[[ctypes.CDLL], None],
+    *,
+    auto_build: bool = True,
+) -> Optional[ctypes.CDLL]:
+    """Load native/<so_name>, building it first if absent.
+
+    `configure(lib)` sets restype/argtypes; an AttributeError there (stale
+    .so missing a symbol) makes the load fail soft. Returns None when no
+    toolchain/library is available — callers fall back to their pure-Python
+    paths. The result (including failure) is cached per process.
+    """
+    with _cache_lock:
+        if so_name in _cache:
+            return _cache[so_name]
+        lib = _load(so_name, configure, auto_build)
+        _cache[so_name] = lib
+        return lib
+
+
+def _load(
+    so_name: str, configure: Callable[[ctypes.CDLL], None], auto_build: bool
+) -> Optional[ctypes.CDLL]:
+    path = os.path.join(NATIVE_DIR, so_name)
+    if not os.path.exists(path):
+        if not auto_build:
+            return None
+        lock_path = os.path.join(NATIVE_DIR, ".build.lock")
+        try:
+            with open(lock_path, "w") as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                try:
+                    if not os.path.exists(path):  # a peer may have built it
+                        subprocess.run(
+                            ["make", "-s", so_name],
+                            cwd=NATIVE_DIR,
+                            check=True,
+                            capture_output=True,
+                            timeout=120,
+                        )
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+        configure(lib)
+    except (OSError, AttributeError):
+        return None
+    return lib

@@ -58,6 +58,7 @@ class BPETokenizer:
         self._decode_table: Dict[int, bytes] = {i: bytes([i]) for i in range(256)}
         for i, (a, b) in enumerate(self.merges):
             self._decode_table[256 + i] = self._decode_table[a] + self._decode_table[b]
+        self._native = None  # lazily constructed C++ encoder (or False = tried)
 
     # -- tiktoken-compatible surface ------------------------------------
     @property
@@ -69,9 +70,22 @@ class BPETokenizer:
         return self.special_tokens["<|endoftext|>"]
 
     def encode_ordinary(self, text: str) -> List[int]:
-        ids = list(text.encode("utf-8"))
+        data = text.encode("utf-8")
         if not self.ranks:
-            return ids
+            return list(data)
+        if self._native is None:
+            try:
+                from pretraining_llm_tpu.data.native_bpe import NativeBpeEncoder
+
+                self._native = NativeBpeEncoder(self.merges)
+            except (RuntimeError, OSError, ImportError):
+                self._native = False  # toolchain absent: Python sweep below
+        if self._native:
+            return self._native.encode_bytes(data)
+        return self._encode_python(list(data))
+
+    def _encode_python(self, ids: List[int]) -> List[int]:
+        """Reference greedy sweep — the correctness oracle for the C++ path."""
         while len(ids) >= 2:
             # find the lowest-rank adjacent pair
             best_rank = None

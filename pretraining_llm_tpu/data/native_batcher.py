@@ -9,58 +9,31 @@ sampling state is one integer, which makes checkpoint resume trivially exact.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libbatcher.so")
-_build_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+from pretraining_llm_tpu.data._native import load_native_lib
 
 
-def _load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
-    global _lib
-    if _lib is not None:
-        return _lib
-    with _build_lock:
-        if _lib is not None:
-            return _lib
-        if not os.path.exists(_LIB_PATH):
-            if not auto_build:
-                return None
-            try:
-                subprocess.run(
-                    ["make", "-s", "libbatcher.so"],
-                    cwd=_NATIVE_DIR,
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except (subprocess.SubprocessError, FileNotFoundError, OSError):
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        lib.batcher_open.restype = ctypes.c_void_p
-        lib.batcher_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        ]
-        lib.batcher_num_tokens.restype = ctypes.c_int64
-        lib.batcher_num_tokens.argtypes = [ctypes.c_void_p]
-        lib.batcher_sample.restype = None
-        lib.batcher_sample.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.batcher_close.restype = None
-        lib.batcher_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.batcher_open.restype = ctypes.c_void_p
+    lib.batcher_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.batcher_num_tokens.restype = ctypes.c_int64
+    lib.batcher_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.batcher_sample.restype = None
+    lib.batcher_sample.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.batcher_close.restype = None
+    lib.batcher_close.argtypes = [ctypes.c_void_p]
+
+
+def _load_library():
+    return load_native_lib("libbatcher.so", _configure)
 
 
 def native_available() -> bool:

@@ -1,0 +1,89 @@
+"""ctypes bindings for the native C++ BPE encoder (native/bpe.cpp).
+
+Auto-builds `native/libbpe.so` on first use when a toolchain is present (via
+data/_native.py, cross-process safe); `BPETokenizer.encode_ordinary` falls
+back to the pure-Python sweep otherwise. The native encoder is bit-identical
+to the Python path (tests/test_tokenizer.py::test_native_bpe_matches_python_sweep)
+— it exists because offline corpus tokenization is the one data-prep stage
+whose cost scales with raw corpus bytes, the same reason the reference leans
+on tiktoken's native BPE (scripts/data_preprocess.py:29-34).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from pretraining_llm_tpu.data._native import load_native_lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_create.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    lib.bpe_encode.restype = ctypes.c_int64
+    lib.bpe_encode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.bpe_destroy.restype = None
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+
+
+def _load_library():
+    return load_native_lib("libbpe.so", _configure)
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class NativeBpeEncoder:
+    """Holds a native merge table; encodes UTF-8 byte buffers to token ids."""
+
+    def __init__(self, merges: Sequence[Tuple[int, int]]) -> None:
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native BPE library unavailable (no toolchain?)")
+        self._lib = lib
+        a = np.asarray([m[0] for m in merges], np.int32)
+        b = np.asarray([m[1] for m in merges], np.int32)
+        self._handle = lib.bpe_create(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(merges),
+        )
+        if not self._handle:
+            raise RuntimeError("bpe_create failed")
+
+    def encode_bytes(self, data: bytes) -> List[int]:
+        n = len(data)
+        if n == 0:
+            return []
+        buf = np.frombuffer(data, np.uint8)
+        out = np.empty(n, np.int32)
+        m = self._lib.bpe_encode(
+            self._handle,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out[:m].tolist()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.bpe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

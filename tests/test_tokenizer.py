@@ -52,3 +52,43 @@ def test_get_tokenizer_byte_and_unknown():
     assert get_tokenizer("byte").n_vocab == 257
     with pytest.raises(ValueError):
         get_tokenizer("nonsense")
+
+
+def test_native_bpe_matches_python_sweep():
+    """The C++ encoder (native/bpe.cpp) must be bit-identical to the Python
+    greedy sweep — same lowest-rank-first, leftmost-first merge order."""
+    import random
+
+    from pretraining_llm_tpu.data import native_bpe
+    from pretraining_llm_tpu.data.bpe import BPETokenizer
+
+    if not native_bpe.native_available():
+        import pytest
+
+        pytest.skip("no C++ toolchain to build libbpe.so")
+
+    corpus = [
+        "the quick brown fox jumps over the lazy dog " * 20,
+        "hello hello hello world world " * 30,
+        "aaaa bbbb aaaa bbbb abab " * 25,
+    ]
+    tok = BPETokenizer.train(corpus, vocab_size=300)
+    enc = native_bpe.NativeBpeEncoder(tok.merges)
+
+    rng = random.Random(0)
+    samples = corpus + [
+        "",
+        "a",
+        "aaaaaaaa",
+        "the the the",
+        "éèê unicode café naïve",  # multi-byte UTF-8
+        "".join(rng.choice("abcdefgh \n\t") for _ in range(2000)),
+        "".join(chr(rng.randrange(32, 1000)) for _ in range(500)),
+    ]
+    for text in samples:
+        byte_ids = list(text.encode("utf-8"))
+        want = tok._encode_python(list(byte_ids))
+        got = enc.encode_bytes(text.encode("utf-8"))
+        assert got == want, f"native != python for {text[:40]!r}"
+        # and the public path (which routes through native) round-trips
+        assert tok.decode(tok.encode_ordinary(text)) == text
