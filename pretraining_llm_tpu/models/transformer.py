@@ -188,11 +188,12 @@ def _attention_block(
     k = checkpoint_name(k, "qkv")
     v = checkpoint_name(v, "qkv")
 
-    # GQA: the naive grouped einsum and the Pallas flash kernel both attend
-    # H query heads against G KV heads directly (no K/V expansion — the
-    # cache/HBM-bandwidth win; the kernel's index maps share KV blocks across
-    # the group). Only ring/ulysses still expect equal head counts and repeat
-    # KV up front (training-time only; same HBM cost as MHA KV would have).
+    # GQA: naive einsum, the Pallas flash kernel, and ring attention all
+    # attend H query heads against G KV heads directly (no K/V expansion —
+    # the cache/HBM-bandwidth win; ring additionally rotates G/H the KV
+    # bytes around the seq axis). Ring needs whole groups per tensor shard
+    # (G % tensor == 0); Ulysses still expects equal head counts — both
+    # repeat KV up front otherwise (training-time only).
     n_rep = cfg.n_heads // cfg.kv_heads
 
     def rep(a: jax.Array) -> jax.Array:
@@ -225,6 +226,12 @@ def _attention_block(
         )
     else:
         grouped_ok = cfg.attention_impl in ("naive", "flash")
+        if cfg.attention_impl == "ring":
+            from pretraining_llm_tpu.parallel.ring_attention import ring_supports_grouped
+
+            grouped_ok = ring_supports_grouped(
+                current_mesh(), cfg.n_heads, cfg.kv_heads
+            )
         out = multihead_attention(
             q,
             k if grouped_ok else rep(k),

@@ -81,15 +81,19 @@ def _partial_flash(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Blockwise partial attention returning unnormalized online-softmax stats.
 
-    q: (B, tq, H, D); k, v: (B, tk, H, D). ``mode`` is a traced scalar:
-    _SKIP returns empty stats without touching the MXU (lax.switch at the
-    call site picks the branch at runtime), _CAUSAL masks assuming q and k
-    cover the SAME aligned chunk (the only causal case both layouts produce),
-    _FULL attends unmasked. Returns (o_unnormalized (B,tq,H,D) fp32,
-    m (B,H,tq) fp32, l (B,H,tq) fp32).
+    q: (B, tq, H, D); k, v: (B, tk, G, D) with G | H — grouped-query
+    attention attends each group's H/G query heads against its shared KV head
+    directly (never expanding K/V, so the ring's ppermute volume is G/H of
+    the MHA cost). ``mode`` is a traced scalar: _SKIP returns empty stats
+    without touching the MXU (lax.switch at the call site picks the branch at
+    runtime), _CAUSAL masks assuming q and k cover the SAME aligned chunk
+    (the only causal case both layouts produce), _FULL attends unmasked.
+    Returns (o_unnormalized (B,tq,H,D) fp32, m (B,H,tq) fp32, l (B,H,tq)
+    fp32) — stats always in flattened-H layout.
     """
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, g = k.shape[1], k.shape[2]
+    rep = h // g
     scale = 1.0 / (d**0.5)
     bk = min(block_kv, tk)
     while tk % bk != 0:
@@ -101,14 +105,17 @@ def _partial_flash(
 
     def attend(causal: bool):
         q_ids = jnp.arange(tq)
+        qg = q.reshape(b, tq, g, rep, d)
 
         def kv_step(carry, inp):
             o, m, l = carry
-            j, kb, vb = inp
+            j, kb, vb = inp  # kb, vb: (B, bk, G, D)
             s = (
-                jnp.einsum("bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32)
+                jnp.einsum(
+                    "bqgrd,bkgd->bgrqk", qg, kb, preferred_element_type=jnp.float32
+                )
                 * scale
-            )
+            ).reshape(b, h, tq, bk)
             if causal:
                 k_pos = j * bk + jnp.arange(bk)
                 s = jnp.where((q_ids[:, None] >= k_pos[None, :])[None, None], s, NEG_INF)
@@ -117,13 +124,16 @@ def _partial_flash(
             alpha = jnp.exp(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1)
             pv = jnp.einsum(
-                "bhqk,bkhd->bqhd", p.astype(v.dtype), vb, preferred_element_type=jnp.float32
-            )
+                "bgrqk,bkgd->bqgrd",
+                p.reshape(b, g, rep, tq, bk).astype(v.dtype),
+                vb,
+                preferred_element_type=jnp.float32,
+            ).reshape(b, tq, h, d)
             o = o * alpha.transpose(0, 2, 1)[..., None] + pv
             return (o, m_new, l), None
 
-        kb = k.reshape(b, nk, bk, h, d).swapaxes(0, 1)
-        vb = v.reshape(b, nk, bk, h, d).swapaxes(0, 1)
+        kb = k.reshape(b, nk, bk, g, d).swapaxes(0, 1)
+        vb = v.reshape(b, nk, bk, g, d).swapaxes(0, 1)
         (o, m, l), _ = jax.lax.scan(kv_step, empty(), (jnp.arange(nk), kb, vb))
         return o, m, l
 
@@ -205,6 +215,27 @@ def _ring_local(
     return (o / safe_l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def ring_supports_grouped(
+    mesh: Optional[Mesh],
+    n_heads: int,
+    n_kv_heads: int,
+    *,
+    seq_axis: str = "seq",
+    head_axis: Optional[str] = "tensor",
+) -> bool:
+    """Whether grouped (un-expanded) KV can be fed to the ring dispatch.
+
+    True when ring won't actually run (no seq axis — the naive fallback is
+    grouped-native anyway) or when every head-axis shard holds whole KV
+    groups. Single source of truth for the caller-side guard in
+    models.transformer and the trace-time check in ring_attention.
+    """
+    if mesh is None or mesh.shape.get(seq_axis, 1) <= 1:
+        return True
+    tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+    return n_kv_heads % tp == 0 or n_kv_heads == n_heads
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -218,7 +249,9 @@ def ring_attention(
     layout: str = "contiguous",
     block_kv: int = 512,
 ) -> jax.Array:
-    """Global-view entry: q, k, v (B, T, H, Dh) with T sharded over seq_axis.
+    """Global-view entry: q (B, T, H, Dh), k/v (B, T, G, Dh) with G | H
+    (grouped-query attention rotates only the G KV heads around the ring),
+    T sharded over seq_axis.
 
     Nested inside the jitted forward via shard_map; degenerates to a single
     local block (no communication) when the seq axis has size 1. With
@@ -229,7 +262,20 @@ def ring_attention(
     axis_size = mesh.shape[seq_axis]
     if layout == "zigzag" and (q.shape[1] // axis_size) % 2 != 0:
         raise ValueError("zigzag layout needs an even per-device sequence length")
+    h, g = q.shape[2], k.shape[2]
+    if h % g != 0:
+        raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
+    if g < h and not ring_supports_grouped(
+        mesh, h, g, seq_axis=seq_axis, head_axis=head_axis
+    ):
+        # Head-sharded q with unshardable grouped KV would misalign groups
+        # inside the manual region; the caller must expand K/V first.
+        raise ValueError(
+            f"grouped ring attention needs kv heads ({g}) divisible by the "
+            f"'{head_axis}' mesh axis; expand K/V to full heads instead"
+        )
     spec = P(batch_axes, seq_axis, head_axis, None)
+    kv_spec = P(batch_axes, seq_axis, head_axis, None)
     local = functools.partial(
         _ring_local,
         causal=causal,
@@ -239,5 +285,5 @@ def ring_attention(
         block_kv=block_kv,
     )
     return jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        local, mesh=mesh, in_specs=(spec, kv_spec, kv_spec), out_specs=spec, check_vma=False
     )(q, k, v)
