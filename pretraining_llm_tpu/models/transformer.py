@@ -188,12 +188,13 @@ def _attention_block(
     k = checkpoint_name(k, "qkv")
     v = checkpoint_name(v, "qkv")
 
-    # GQA: naive einsum, the Pallas flash kernel, and ring attention all
-    # attend H query heads against G KV heads directly (no K/V expansion —
-    # the cache/HBM-bandwidth win; ring additionally rotates G/H the KV
-    # bytes around the seq axis). Ring needs whole groups per tensor shard
-    # (G % tensor == 0); Ulysses still expects equal head counts — both
-    # repeat KV up front otherwise (training-time only).
+    # GQA: every attention path attends H query heads against G KV heads
+    # directly when the layout allows it (no K/V expansion — the cache/HBM
+    # bandwidth win; ring/ulysses additionally move G/H the KV bytes through
+    # their collectives). Ring needs whole groups per tensor shard, ulysses
+    # needs the KV heads to split over tensor x seq shards (see the
+    # *_supports_grouped predicates); KV is repeated up front otherwise
+    # (training-time only).
     n_rep = cfg.n_heads // cfg.kv_heads
 
     def rep(a: jax.Array) -> jax.Array:
@@ -230,6 +231,12 @@ def _attention_block(
             from pretraining_llm_tpu.parallel.ring_attention import ring_supports_grouped
 
             grouped_ok = ring_supports_grouped(
+                current_mesh(), cfg.n_heads, cfg.kv_heads
+            )
+        elif cfg.attention_impl == "ulysses":
+            from pretraining_llm_tpu.parallel.ulysses import ulysses_supports_grouped
+
+            grouped_ok = ulysses_supports_grouped(
                 current_mesh(), cfg.n_heads, cfg.kv_heads
             )
         out = multihead_attention(

@@ -45,6 +45,10 @@ def _ulysses_local(
     def heads_to_seq(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
+    # GQA: q and k/v exchange independently (H/n vs G/n heads per device);
+    # the contiguous head split is group-aligned — device j's H/n query
+    # heads cover exactly groups [j*G/n, (j+1)*G/n) — so the grouped inner
+    # kernels see whole groups. KV moves G/H the all-to-all bytes of MHA.
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if use_flash:
         from pretraining_llm_tpu.ops.flash_attention import flash_attention
@@ -53,6 +57,29 @@ def _ulysses_local(
     else:
         out = naive_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(out)
+
+
+def ulysses_supports_grouped(
+    mesh: Optional[Mesh],
+    n_heads: int,
+    n_kv_heads: int,
+    *,
+    seq_axis: str = "seq",
+    head_axis: Optional[str] = "tensor",
+) -> bool:
+    """Whether grouped (un-expanded) KV can ride the all-to-all exchange.
+
+    True when ulysses won't run (no seq axis — the naive fallback is
+    grouped-native) or when the KV heads split evenly over both the head
+    (tensor) shards and the seq-axis all-to-all.
+    """
+    if mesh is None or mesh.shape.get(seq_axis, 1) <= 1:
+        return True
+    if n_kv_heads == n_heads:
+        return True
+    tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+    n = mesh.shape[seq_axis]
+    return n_kv_heads % tp == 0 and (n_kv_heads // tp) % n == 0
 
 
 def ulysses_attention(
@@ -69,12 +96,25 @@ def ulysses_attention(
     block_q: int = 0,
     block_kv: int = 0,
 ) -> jax.Array:
-    """Global-view entry: q, k, v (B, T, H, Dh), T sharded over seq_axis."""
+    """Global-view entry: q (B, T, H, Dh), k/v (B, T, G, Dh) with G | H
+    (grouped-query attention exchanges only the G KV heads), T sharded over
+    seq_axis."""
     n = mesh.shape[seq_axis]
-    h_local = q.shape[2] // (mesh.shape[head_axis] if head_axis else 1)
+    h, g = q.shape[2], k.shape[2]
+    if h % g != 0:
+        raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
+    tp = mesh.shape[head_axis] if head_axis else 1
+    h_local = h // tp
     if h_local % n != 0:
         raise ValueError(
             f"ulysses needs per-device heads ({h_local}) divisible by seq axis size ({n})"
+        )
+    if g < h and not ulysses_supports_grouped(
+        mesh, h, g, seq_axis=seq_axis, head_axis=head_axis
+    ):
+        raise ValueError(
+            f"grouped ulysses needs kv heads ({g}) divisible by "
+            f"{head_axis} x {seq_axis} shards; expand K/V to full heads instead"
         )
     spec = P(batch_axes, seq_axis, head_axis, None)
     local = functools.partial(
