@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -154,6 +155,10 @@ class Trainer:
         else:
             state = jax.device_put(state)
         self.state = state
+        # Set by the SIGTERM handler (TPU preemption / maintenance events
+        # deliver SIGTERM); the loop checkpoints and stops at the next step
+        # boundary instead of dying mid-step.
+        self._stop_requested = False
 
     def _make_iterator(self, path: str, seed: int):
         """File iterator: native C++ gatherer when built, numpy otherwise.
@@ -236,11 +241,43 @@ class Trainer:
         )
 
     # ------------------------------------------------------------------
+    _NOT_INSTALLED = object()  # sentinel: handler could not be installed
+
+    def _install_preemption_handler(self):
+        """SIGTERM -> request a graceful stop. Returns the previous handler
+        (restored by train's finally; may legitimately be None for a C-level
+        handler) or _NOT_INSTALLED when installation failed (non-main
+        thread / embedded interpreter)."""
+
+        def handler(signum, frame):  # noqa: ARG001 — signal API shape
+            self._stop_requested = True
+
+        try:
+            return signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            return Trainer._NOT_INSTALLED
+
+    def _stop_synced(self) -> bool:
+        """Whether ANY process requested a stop. Multi-host preemption can
+        deliver SIGTERM to one host first; syncing the flag keeps every
+        process entering the (collective) checkpoint save together. Called
+        at log boundaries only — one tiny DCN allgather per log interval."""
+        if jax.process_count() == 1:
+            return self._stop_requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._stop_requested], dtype=np.bool_)
+        )
+        return bool(np.asarray(flags).any())
+
     def train(self, steps: Optional[int] = None) -> Dict[str, float]:
         tcfg = self.config.train
         total = steps if steps is not None else tcfg.train_steps
         tokens_per_step = tcfg.batch_size * self.config.model.context_length
         is_host0 = jax.process_index() == 0
+        self._stop_requested = False  # a prior run's SIGTERM must not persist
+        prev_sigterm = self._install_preemption_handler()
 
         from pretraining_llm_tpu.utils.profiling import StepProfiler
 
@@ -252,6 +289,7 @@ class Trainer:
         # the device until a metric sync at a log boundary.
         last: Dict[str, float] = {}
         step = self.start_step
+        preempted = False
         try:
             for step in range(self.start_step, total):
                 profiler.step(step)
@@ -259,7 +297,14 @@ class Trainer:
                 self.state, metrics = self.step_fn(self.state, batch)
                 self.throughput.tick(tokens_per_step)
 
-                if (step + 1) % tcfg.log_interval == 0 or step + 1 == total:
+                at_log = (step + 1) % tcfg.log_interval == 0 or step + 1 == total
+                if at_log and self._stop_synced():
+                    preempted = True
+                    if is_host0:
+                        self.logger.log({"event": "preempted", "step": step + 1})
+                    self.save(step + 1)
+                    break
+                if at_log:
                     last = {k: float(v) for k, v in metrics.items()}  # device sync
                     last.update(self.throughput.window())
                     if is_host0:
@@ -296,7 +341,11 @@ class Trainer:
             raise
         finally:
             profiler.close()
+            if prev_sigterm is not Trainer._NOT_INSTALLED:
+                signal.signal(signal.SIGTERM, prev_sigterm)
 
+        if preempted:
+            return last  # already checkpointed at the stop step
         if tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0:
             self.save(total)
         return last

@@ -100,3 +100,88 @@ def test_trainer_saves_on_failure(tmp_path):
     assert t2.start_step == 4
     t2.train()
     assert ckpt.latest_checkpoint(ckdir).endswith("step-10")
+
+
+def test_trainer_checkpoints_on_sigterm(tmp_path):
+    """TPU preemption delivers SIGTERM: the loop must checkpoint at the next
+    step boundary and return cleanly (no exception), and a fresh trainer
+    resumes from the preemption point."""
+    import signal
+
+    ckdir = str(tmp_path / "ck")
+    cfg = get_preset("tiny").with_overrides(
+        {
+            "train.train_steps": 10,
+            "train.checkpoint_interval": 0,
+            "train.eval_interval": 0,
+            "train.log_interval": 1,  # stop checks happen at log boundaries
+            "train.checkpoint_dir": ckdir,
+        }
+    )
+    t = Trainer(cfg, synthetic_data=True, resume=False)
+    real_iter = t.train_iterator
+
+    class Preempting:
+        """Delivers SIGTERM to our own process while fetching batch 4."""
+
+        def __init__(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == 4:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return next(real_iter)
+
+    t.train_iterator = Preempting()
+    t.train()  # returns instead of dying
+    latest = ckpt.latest_checkpoint(ckdir)
+    assert latest is not None and latest.endswith("step-4")
+    # The handler is uninstalled after train() (back to default/previous).
+    assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL, signal.default_int_handler)
+
+    t2 = Trainer(cfg, synthetic_data=True, resume=True)
+    assert t2.start_step == 4
+    t2.train()
+    assert ckpt.latest_checkpoint(ckdir).endswith("step-10")
+
+
+def test_trainer_reusable_after_sigterm(tmp_path):
+    """A preempted run's stop flag must not leak into the next train() call
+    (incremental training via train(steps=N) on the same object)."""
+    ckdir = str(tmp_path / "ck")
+    cfg = get_preset("tiny").with_overrides(
+        {
+            "train.train_steps": 4,
+            "train.checkpoint_interval": 0,
+            "train.eval_interval": 0,
+            "train.log_interval": 1,
+            "train.checkpoint_dir": ckdir,
+        }
+    )
+    t = Trainer(cfg, synthetic_data=True, resume=False)
+    t.start_step = 0
+    real_iter = t.train_iterator
+
+    class OneShotPreempt:
+        def __init__(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == 2:
+                os.kill(os.getpid(), __import__("signal").SIGTERM)
+            return next(real_iter)
+
+    t.train_iterator = OneShotPreempt()
+    t.train(steps=2)  # preempted at step 2
+    assert ckpt.latest_checkpoint(ckdir).endswith("step-2")
+    t.start_step = 2
+    t.train(steps=4)  # stale flag cleared at entry: runs to completion
+    assert ckpt.latest_checkpoint(ckdir).endswith("step-4")
