@@ -392,20 +392,10 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     name: str = "custom"
 
-    def __post_init__(self) -> None:
-        # Pipeline sharding assigns block params P('pipe', ...) — the stage
-        # split replaces per-weight expert/tensor/fsdp specs (each stage
-        # computes on whole weights). A mesh that also sizes those axes >1
-        # would silently replicate every block weight across them; reject it.
-        if self.model.pipeline_stages > 1 and (
-            self.mesh.expert > 1 or self.mesh.tensor > 1 or self.mesh.fsdp > 1
-        ):
-            raise ValueError(
-                "pipeline_stages>1 shards block params over 'pipe' only; "
-                "combine it with data parallelism, not expert/tensor/fsdp "
-                f"axes (got mesh expert={self.mesh.expert} "
-                f"tensor={self.mesh.tensor} fsdp={self.mesh.fsdp})"
-            )
+    # NOTE: pipeline stage assignment (P('pipe', ...) on the stacked layer
+    # dim) COMPOSES with the per-weight expert/tensor/fsdp specs — no mesh-
+    # combination restriction needed here (seq/ring composition is rejected
+    # in ModelConfig).
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)

@@ -23,9 +23,11 @@ threads, no schedule executor; the whole pipeline is ONE jitted SPMD program:
   - Embeddings / final norm / lm-head stay outside the region under plain
     GSPMD, replicated over 'pipe' (they are a tiny fraction of compute).
 
-Composes with the 'data'/'fsdp' batch axes (batch stays sharded inside the
-region). Within a stage, weights are replicated over fsdp/tensor — PP here is
-an alternative to FSDP/TP for the layer stack, as in the dryrun configs.
+Composes with the other mesh axes: the shard_map region is manual over
+'pipe' ONLY (jax partial-manual mode), so the batch dims stay auto-sharded
+over 'data'/'fsdp' and each stage's weights keep their tensor/fsdp/expert
+specs with GSPMD inserting the TP/EP collectives inside the stage body —
+PP x TP x DP 3-D parallelism from one schedule.
 """
 
 from __future__ import annotations
@@ -36,7 +38,7 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 BlockFn = Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]
 
@@ -78,7 +80,11 @@ def pipeline_apply(
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the stacked layer stack as a pipeline.
 
-    blocks: stacked block params, leading dim n_layers (sharded over 'pipe').
+    blocks: stacked block params, leading dim n_layers sharded over 'pipe'
+    COMPOSED with per-weight expert/tensor/fsdp dims (parallel.sharding
+    composes them): the shard_map region is manual over 'pipe' ONLY, so
+    GSPMD keeps inserting the TP/FSDP/EP collectives inside each stage —
+    PP x TP x DP 3-D parallelism from one schedule.
     x: (B, T, D) embedded activations; B divides into n_micro microbatches.
     block_fn: (block_params, x) -> (x, aux) for ONE layer.
     interleave: virtual stages per rank (V). V=1 is plain GPipe. V>1 splits
@@ -93,16 +99,16 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[pipe_axis]
     b = x.shape[0]
-    # The PER-SHARD batch must divide into microbatches (the reshape happens
-    # inside the manual region, after the batch axes split it).
+    # Microbatching happens on the GLOBAL batch (the batch dims stay
+    # auto-sharded over the data axes inside the region); each microbatch
+    # must still split evenly over the data shards.
     batch_shards = 1
     for ax in batch_axes:
         batch_shards *= mesh.shape.get(ax, 1)
-    if b % batch_shards != 0 or (b // batch_shards) % n_micro != 0:
+    if b % n_micro != 0 or (b // n_micro) % batch_shards != 0:
         raise ValueError(
-            f"global batch {b} over {batch_shards} data shards gives a local "
-            f"batch of {b // batch_shards if b % batch_shards == 0 else b / batch_shards}, "
-            f"not divisible by pipeline_microbatches={n_micro}"
+            f"global batch {b} must split into pipeline_microbatches="
+            f"{n_micro} of a size divisible by the {batch_shards} data shards"
         )
     if x.shape[1] % n_stages != 0:
         raise ValueError(
@@ -134,20 +140,28 @@ def pipeline_apply(
             .transpose(1, 0, 2)
             .reshape(-1)
         )
-        spec = NamedSharding(mesh, P(pipe_axis))
-        blocks = jax.tree.map(
-            lambda a: jax.lax.with_sharding_constraint(a[perm_idx], spec), blocks
-        )
+        blocks = jax.tree.map(lambda a: a[perm_idx], blocks)
 
-    def local(blocks_local: Any, x_local: jax.Array):
-        # blocks_local: leading dim n_layers/n_stages (= V*lpc, chunk-ordered
-        # when interleave>1); x_local: (b_local, T, D)
-        from pretraining_llm_tpu.parallel.sharding import activation_mesh
+    # The XLA CPU emitter check-fails ("Invalid binary instruction opcode
+    # copy") on any bf16 all-reduce-family collective inside a partial-manual
+    # region. Two such collectives exist here: the output reduce-scatter and
+    # the IMPLICIT psum that transposes the replicated-x input in backward.
+    # On CPU route both through fp32 by widening x at the region boundary
+    # (TPU runs bf16 collectives natively and skips all of this).
+    act_dtype = x.dtype
+    boundary_f32 = jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16
+    if boundary_f32:
+        x = x.astype(jnp.float32)
 
+    def local(blocks_local: Any, x_global: jax.Array):
+        # Manual over 'pipe' only: blocks_local is this rank's layer slice
+        # (leading dim n_layers/n_stages = V*lpc, chunk-ordered when
+        # interleave>1) but x_global is the full (B, T, D) batch — its data/
+        # tensor sharding stays under GSPMD (auto axes).
         rank = jax.lax.axis_index(pipe_axis)
-        bl = x_local.shape[0]
-        mb = bl // n_micro
-        mbs = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        x_global = x_global.astype(act_dtype)
+        mb = b // n_micro
+        mbs = x_global.reshape(n_micro, mb, *x_global.shape[1:])
         chunks = jax.tree.map(
             lambda a: a.reshape(interleave, lpc, *a.shape[1:]), blocks_local
         )
@@ -211,44 +225,42 @@ def pipeline_apply(
             recv = jax.lax.ppermute(y, pipe_axis, perm)
             return (recv, wrap_buf, out_buf, aux_sum), None
 
-        # GSPMD sharding constraints are meaningless inside the manual region.
-        with activation_mesh(None):
-            wrap0 = (
-                jnp.zeros_like(mbs)
-                if interleave > 1
-                else jnp.zeros((0,), x_local.dtype)
-            )
-            init = (
-                jnp.zeros((mb, *x_local.shape[1:]), x_local.dtype),
-                wrap0,
-                jnp.zeros_like(mbs),
-                jnp.zeros((), jnp.float32),
-            )
-            (_, _, out_buf, aux_sum), _ = jax.lax.scan(
-                tick, init, jnp.arange(schedule_ticks(n_micro, n_stages, interleave))
-            )
+        wrap0 = (
+            jnp.zeros_like(mbs)
+            if interleave > 1
+            else jnp.zeros((0,), x_global.dtype)
+        )
+        init = (
+            jnp.zeros((mb, *x_global.shape[1:]), x_global.dtype),
+            wrap0,
+            jnp.zeros_like(mbs),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, out_buf, aux_sum), _ = jax.lax.scan(
+            tick, init, jnp.arange(schedule_ticks(n_micro, n_stages, interleave))
+        )
 
-        out = out_buf.reshape(bl, *x_local.shape[1:])
+        out = out_buf.reshape(b, *x_global.shape[1:])
         # Return routing: out_buf is zeros on every rank but the last, so a
         # reduce-scatter over 'pipe' hands each rank its 1/n_stages slice of
         # the sequence dim — half the bandwidth of the old full-activation
         # psum broadcast, and the final-norm/lm-head/CE downstream now runs
         # seq-sharded over the pipe axis instead of replicated on it.
-        out = jax.lax.psum_scatter(out, pipe_axis, scatter_dimension=1, tiled=True)
-        # Aux statistics are per (data shard x microbatch) group; average over
-        # microbatches AND the batch axes so the scalar is well-defined
-        # (replicated) everywhere.
+        rs_dtype = jnp.float32 if boundary_f32 else out.dtype
+        out = jax.lax.psum_scatter(
+            out.astype(rs_dtype), pipe_axis, scatter_dimension=1, tiled=True
+        ).astype(out.dtype)
+        # aux was computed over the GLOBAL batch inside each stage (auto
+        # axes); sum over the pipe ranks' chunks, average over microbatches.
         aux_total = jax.lax.psum(aux_sum, pipe_axis) / n_micro
-        aux_total = jax.lax.pmean(aux_total, batch_axes)
         return out, aux_total
 
     blocks_spec = jax.tree.map(lambda _: P(pipe_axis), blocks)
-    x_spec = P(batch_axes)
-    out_spec = P(batch_axes, pipe_axis)
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(blocks_spec, x_spec),
-        out_specs=(out_spec, P()),
+        in_specs=(blocks_spec, P()),
+        out_specs=(P(None, pipe_axis), P()),
+        axis_names={pipe_axis},
         check_vma=False,
     )(blocks, x)

@@ -45,15 +45,18 @@ def param_pspec(path_names: Tuple[str, ...], ndim: int, pipeline: bool = False) 
     Parameters under 'blocks' are stacked with a leading n_layers dim (scanned
     by the model). Without pipelining that dim is never sharded (leading None);
     with ``pipeline=True`` it shards over 'pipe' (stage assignment IS the
-    sharding) and the weight dims replicate — inside the manual pipeline
-    region each stage computes on whole-weight shards.
+    sharding) COMPOSED with the per-weight expert/tensor/fsdp dims — the
+    pipeline region is manual over 'pipe' only, so GSPMD keeps handling TP/
+    FSDP/EP collectives inside each stage (PP x TP x DP 3-D parallelism).
     """
     name = path_names[-1]
     parent = path_names[-2] if len(path_names) >= 2 else ""
     in_blocks = "blocks" in path_names
 
     if pipeline and in_blocks:
-        return P("pipe", *([None] * (ndim - 1)))
+        base = tuple(param_pspec(path_names, ndim, pipeline=False))
+        base = base + (None,) * (ndim - len(base))  # P() drops trailing Nones
+        return P("pipe", *base[1:])
 
     def blk(*spec: Optional[str]) -> P:
         return P(None, *spec) if in_blocks else P(*spec)
@@ -169,8 +172,17 @@ def current_mesh() -> Optional[Mesh]:
 
 def constrain(x: jax.Array, *spec: Any) -> jax.Array:
     """Annotate an intermediate with a sharding over the active mesh (no-op
-    when no mesh is installed)."""
+    when no mesh is installed).
+
+    Inside a partial-manual shard_map region (e.g. the pipeline, manual over
+    'pipe' only) the trace context carries an AbstractMesh whose manual axes
+    differ from the installed Mesh's; the constraint must be built against
+    that context mesh or XLA rejects the mismatch. Specs here only ever name
+    auto axes (data/fsdp/tensor/seq/expert), so they stay valid either way.
+    """
     mesh = _CURRENT_MESH
     if mesh is None:
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    context = jax.sharding.get_abstract_mesh()
+    target = context if not context.empty else mesh
+    return jax.lax.with_sharding_constraint(x, NamedSharding(target, P(*spec)))

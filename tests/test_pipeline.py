@@ -150,10 +150,11 @@ def test_pipeline_with_moe_aux(mesh_pipe4):
     ref = jax.jit(lambda p, t: transformer.forward(p, t, cfg, return_aux=True))
     ref_logits, _, _ = ref(params, tokens)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), rtol=1e-4, atol=1e-4)
-    # Pipeline aux = mean over (data shard x microbatch) groups — here each
-    # group is a single sequence (B=4 over 2 data shards x 2 microbatches).
-    per_seq = [float(ref(params, tokens[i : i + 1])[2]) for i in range(4)]
-    np.testing.assert_allclose(float(aux), np.mean(per_seq), rtol=1e-4)
+    # Pipeline aux = mean over GLOBAL microbatches (contiguous row blocks:
+    # B=4 over 2 microbatches -> rows (0,1) and (2,3)) — the same grouping
+    # the non-pipelined loss sees per microbatch.
+    per_mb = [float(ref(params, tokens[i : i + 2])[2]) for i in (0, 2)]
+    np.testing.assert_allclose(float(aux), np.mean(per_mb), rtol=1e-4)
 
 
 def test_schedule_is_minimal_gpipe_and_bubble_shrinks_with_microbatches():
@@ -236,3 +237,51 @@ def test_interleave_shrinks_bubble():
 def test_interleave_requires_stages():
     with pytest.raises(ValueError, match="pipeline_stages > 1"):
         ModelConfig(n_layers=4, pipeline_stages=1, pipeline_interleave=2)
+
+
+@pytest.fixture(scope="module")
+def mesh_pp_tp() -> Mesh:
+    devs = np.asarray(jax.devices()).reshape(2, 1, 2, 1, 1, 2)
+    return Mesh(devs, ("data", "fsdp", "tensor", "seq", "expert", "pipe"))
+
+
+def test_pipeline_composes_with_tensor_parallel(mesh_pp_tp):
+    """PP x TP x DP: the pipe region is manual over 'pipe' only, so stage
+    weights keep their tensor specs (GSPMD inserts the TP collectives inside
+    each stage) and the step matches the single-device run."""
+    tiny = get_preset("tiny")
+    cfg = tiny.replace(
+        model=dataclasses.replace(
+            tiny.model,
+            n_layers=4,
+            n_heads=4,
+            pipeline_stages=2,
+            pipeline_microbatches=2,
+            pipeline_interleave=2,
+            param_dtype="float32",
+            compute_dtype="float32",
+        ),
+        mesh=dataclasses.replace(tiny.mesh, data=2, tensor=2, pipe=2),
+        train=dataclasses.replace(tiny.train, batch_size=8, microbatches=1),
+    )
+    x = jax.random.randint(jax.random.key(1), (8, cfg.model.context_length), 0,
+                           cfg.model.vocab_size)
+    y = jnp.roll(x, -1, axis=1)
+
+    state = ts.init_train_state(cfg, jax.random.key(0))
+    sharded = ts.shard_train_state(jax.tree.map(jnp.copy, state), mesh_pp_tp, cfg)
+    # TP really shards the stage weights: wqkv (L, D, 3, H, Dh) splits over
+    # pipe on dim 0 AND tensor on dim 3.
+    wqkv = sharded["params"]["blocks"]["attn"]["wqkv"]
+    L, D = cfg.model.n_layers, cfg.model.d_model
+    shard_shape = wqkv.sharding.shard_shape(wqkv.shape)
+    assert shard_shape[0] == L // 2, shard_shape
+    assert shard_shape[3] == cfg.model.n_heads // 2, shard_shape
+
+    step = ts.build_train_step(cfg, mesh_pp_tp)
+    sharded, metrics = step(sharded, (x, y))
+    pipe_loss = float(metrics["loss"])
+
+    single = ts.build_train_step(cfg, mesh=None)
+    state, metrics1 = single(state, (x, y))
+    np.testing.assert_allclose(pipe_loss, float(metrics1["loss"]), rtol=1e-4)
