@@ -285,3 +285,53 @@ def test_pipeline_composes_with_tensor_parallel(mesh_pp_tp):
     single = ts.build_train_step(cfg, mesh=None)
     state, metrics1 = single(state, (x, y))
     np.testing.assert_allclose(pipe_loss, float(metrics1["loss"]), rtol=1e-4)
+
+
+@pytest.mark.parametrize("axis", ["fsdp", "expert"])
+def test_pipeline_composes_with_fsdp_and_ep(axis):
+    """PP x FSDP and PP x EP: stage weights keep their fsdp/expert specs
+    under the partial-manual pipe region and match single-device."""
+    tiny = get_preset("tiny")
+    model_kw = dict(
+        n_layers=4,
+        pipeline_stages=2,
+        pipeline_microbatches=2,
+        param_dtype="float32",
+        compute_dtype="float32",
+    )
+    if axis == "expert":
+        model_kw.update(n_experts=2, experts_per_token=1, expert_capacity_factor=4.0)
+    cfg = tiny.replace(
+        model=dataclasses.replace(tiny.model, **model_kw),
+        mesh=dataclasses.replace(tiny.mesh, data=2, pipe=2, **{axis: 2}),
+        train=dataclasses.replace(tiny.train, batch_size=8, microbatches=1),
+    )
+    shape = [1] * 6
+    names = ("data", "fsdp", "tensor", "seq", "expert", "pipe")
+    for name, size in (("data", 2), (axis, 2), ("pipe", 2)):
+        shape[names.index(name)] = size
+    mesh = Mesh(np.asarray(jax.devices()).reshape(shape), names)
+
+    x = jax.random.randint(jax.random.key(1), (8, cfg.model.context_length), 0,
+                           cfg.model.vocab_size)
+    y = jnp.roll(x, -1, axis=1)
+    state = ts.init_train_state(cfg, jax.random.key(0))
+    sharded = ts.shard_train_state(jax.tree.map(jnp.copy, state), mesh, cfg)
+    # The composed spec really shards stage weights (not just loss parity):
+    # pipe splits the stacked layer dim AND the fsdp/expert dim splits too.
+    if axis == "fsdp":
+        w = sharded["params"]["blocks"]["attn"]["wqkv"]  # (L, D, 3, H, Dh)
+        ss = w.sharding.shard_shape(w.shape)
+        assert ss[0] == cfg.model.n_layers // 2 and ss[1] == cfg.model.d_model // 2, ss
+    else:
+        w = sharded["params"]["blocks"]["mlp"]["experts"]["w1"]  # (L, E, D, F)
+        ss = w.sharding.shard_shape(w.shape)
+        assert ss[0] == cfg.model.n_layers // 2 and ss[1] == 1, ss
+    step = ts.build_train_step(cfg, mesh)
+    sharded, metrics = step(sharded, (x, y))
+
+    single = ts.build_train_step(cfg, mesh=None)
+    state, metrics1 = single(state, (x, y))
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(metrics1["loss"]), rtol=1e-4
+    )
