@@ -37,7 +37,6 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 BlockFn = Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]
@@ -133,14 +132,16 @@ def pipeline_apply(
     if interleave > 1:
         # Chunk j = v*S + r (depth order) must live on rank r. Permute the
         # stacked dim to rank-major (r, v, k) order so the contiguous
-        # P('pipe') shards hold exactly each rank's V chunks.
-        perm_idx = (
-            np.arange(n_layers)
-            .reshape(interleave, n_stages, lpc)
-            .transpose(1, 0, 2)
-            .reshape(-1)
+        # P('pipe') shards hold exactly each rank's V chunks. This is an
+        # inherently cross-rank reshard of the layer stack (XLA may lower it
+        # as replicate-then-reshard) paid once per step — at production scale
+        # you'd bake the permuted layout into the train state instead.
+        blocks = jax.tree.map(
+            lambda a: a.reshape(interleave, n_stages, lpc, *a.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(a.shape),
+            blocks,
         )
-        blocks = jax.tree.map(lambda a: a[perm_idx], blocks)
 
     # The XLA CPU emitter check-fails ("Invalid binary instruction opcode
     # copy") on any bf16 all-reduce-family collective inside a partial-manual
