@@ -363,6 +363,11 @@ class TrainConfig:
     checkpoint_dir: str = "checkpoints"
     checkpoint_interval: int = 1000  # reference saves only once at the end
     keep_checkpoints: int = 3
+    # Write checkpoint files on a background thread so the step loop never
+    # stalls on disk IO (the device->host snapshot stays synchronous for
+    # exactness). Single-process only: multi-host saves keep the internal
+    # barrier on the main thread.
+    checkpoint_async: bool = False
     log_interval: int = 10
     metrics_path: str = ""  # JSONL sink; "" = stdout only
     debug_nans: bool = False  # op-level NaN detection (slow; debugging only)

@@ -218,11 +218,17 @@ class Trainer:
         batch = (np.stack(xs), np.stack(ys))
         return float(self.eval_loop(self.state, self._put_eval(batch)))
 
-    def save(self, step: int) -> str:
+    def save(self, step: int, *, sync: bool = False) -> Optional[str]:
         """Write a checkpoint. Call from ALL processes in a multi-host run —
         every process persists its own array shards and data-RNG state;
         process 0 alone writes the global metadata (the gating lives inside
-        `checkpoint.save_checkpoint`, not here)."""
+        `checkpoint.save_checkpoint`, not here).
+
+        With ``train.checkpoint_async`` (single-process only), the device ->
+        host snapshot happens here synchronously — the saved state and
+        data-RNG frontier are exactly this step's — but the file IO runs on
+        a background thread and this returns None immediately. ``sync=True``
+        forces a blocking save (failure/final paths)."""
         extra: Dict[str, Any] = {
             "step": step,
             "config": dataclasses.asdict(self.config),
@@ -231,14 +237,51 @@ class Trainer:
         local_extra: Dict[str, Any] = {}
         if hasattr(self.train_iterator, "state"):
             local_extra["data_rng"] = self.train_iterator.state()
-        return ckpt.save_checkpoint(
-            self.config.train.checkpoint_dir,
-            step,
-            self.state,
-            extra=extra,
-            local_extra=local_extra,
+        kwargs = dict(
+            extra=extra, local_extra=local_extra,
             keep=self.config.train.keep_checkpoints,
         )
+        use_async = (
+            self.config.train.checkpoint_async
+            and not sync
+            and jax.process_count() == 1
+        )
+        if not use_async:
+            self.join_pending_save()  # never interleave writes to the dir
+            return ckpt.save_checkpoint(
+                self.config.train.checkpoint_dir, step, self.state, **kwargs
+            )
+        host_state = jax.device_get(self.state)  # pins this step's values
+        self.join_pending_save()
+        import threading
+
+        def write():
+            try:
+                ckpt.save_checkpoint(
+                    self.config.train.checkpoint_dir, step, host_state, **kwargs
+                )
+            except Exception as e:  # surfaced by the next join_pending_save
+                self._pending_save_error = e
+
+        self._pending_save_error: Optional[Exception] = None
+        self._pending_save = threading.Thread(target=write, daemon=True)
+        self._pending_save.start()
+        return None
+
+    def join_pending_save(self) -> None:
+        """Wait for an in-flight async checkpoint write; re-raise its error.
+
+        A swallowed write failure would let a run end 'successfully' with
+        its checkpoints missing — the writer thread's exception must reach
+        the training loop."""
+        pending = getattr(self, "_pending_save", None)
+        if pending is not None:
+            pending.join()
+            self._pending_save = None
+            err = getattr(self, "_pending_save_error", None)
+            if err is not None:
+                self._pending_save_error = None
+                raise RuntimeError("async checkpoint write failed") from err
 
     # ------------------------------------------------------------------
     _NOT_INSTALLED = object()  # sentinel: handler could not be installed
@@ -302,7 +345,7 @@ class Trainer:
                     preempted = True
                     if is_host0:
                         self.logger.log({"event": "preempted", "step": step + 1})
-                    self.save(step + 1)
+                    self.save(step + 1, sync=True)
                     break
                 if at_log:
                     last = {k: float(v) for k, v in metrics.items()}  # device sync
@@ -334,7 +377,7 @@ class Trainer:
             if is_host0:
                 self.logger.log({"event": "failure", "step": step, "error": repr(e)[:200]})
             try:
-                self.save(step)
+                self.save(step, sync=True)
             except Exception as save_err:  # keep the original error primary
                 if is_host0:
                     self.logger.log({"event": "emergency_save_failed", "error": repr(save_err)[:200]})
@@ -343,9 +386,23 @@ class Trainer:
             profiler.close()
             if prev_sigterm is not Trainer._NOT_INSTALLED:
                 signal.signal(signal.SIGTERM, prev_sigterm)
+            # Join the in-flight async write on EVERY exit path — incl.
+            # KeyboardInterrupt/SystemExit, which bypass `except Exception`;
+            # exiting would kill the daemon writer mid-write and lose the
+            # newest checkpoint. Don't let a join failure mask an exception
+            # that is already propagating.
+            import sys as _sys
+
+            try:
+                self.join_pending_save()
+            except RuntimeError:
+                if is_host0:
+                    self.logger.log({"event": "async_checkpoint_failed", "step": step})
+                if _sys.exc_info()[0] is None:
+                    raise
 
         if preempted:
             return last  # already checkpointed at the stop step
         if tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0:
-            self.save(total)
+            self.save(total, sync=True)
         return last

@@ -185,3 +185,71 @@ def test_trainer_reusable_after_sigterm(tmp_path):
     t.start_step = 2
     t.train(steps=4)  # stale flag cleared at entry: runs to completion
     assert ckpt.latest_checkpoint(ckdir).endswith("step-4")
+
+
+def test_async_checkpointing_exact_and_ordered(tmp_path):
+    """checkpoint_async writes off-thread but must (a) snapshot the state
+    of the step it was requested at — not a later one — and (b) leave a
+    loadable checkpoint identical to the sync path."""
+    import dataclasses as dc
+
+    ckdir_async = str(tmp_path / "a")
+    ckdir_sync = str(tmp_path / "s")
+    base = get_preset("tiny").with_overrides(
+        {
+            "train.train_steps": 6,
+            "train.checkpoint_interval": 2,
+            "train.eval_interval": 0,
+            "train.log_interval": 100,
+        }
+    )
+    cfg_a = base.replace(train=dc.replace(base.train, checkpoint_dir=ckdir_async,
+                                          checkpoint_async=True))
+    cfg_s = base.replace(train=dc.replace(base.train, checkpoint_dir=ckdir_sync))
+
+    Trainer(cfg_a, synthetic_data=True, resume=False).train()
+    Trainer(cfg_s, synthetic_data=True, resume=False).train()
+
+    for step in (2, 4, 6):
+        pa, ea = ckpt.load_checkpoint(f"{ckdir_async}/step-{step}",
+                                      _template(cfg_a))
+        ps, es = ckpt.load_checkpoint(f"{ckdir_sync}/step-{step}",
+                                      _template(cfg_s))
+        assert ea["step"] == es["step"] == step
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            pa["params"], ps["params"],
+        )
+
+
+def _template(cfg):
+    from pretraining_llm_tpu.training import train_step as ts_mod
+
+    return jax.eval_shape(lambda: ts_mod.init_train_state(cfg, jax.random.key(cfg.train.seed)))
+
+
+def test_async_checkpoint_write_failure_surfaces(tmp_path, monkeypatch):
+    """A failed background write must raise at the next join, not vanish."""
+    import dataclasses as dc
+
+    from pretraining_llm_tpu.training import trainer as trainer_mod
+
+    cfg = get_preset("tiny").with_overrides(
+        {
+            "train.train_steps": 4,
+            "train.checkpoint_interval": 2,
+            "train.eval_interval": 0,
+            "train.log_interval": 100,
+        }
+    )
+    cfg = cfg.replace(train=dc.replace(cfg.train, checkpoint_dir=str(tmp_path / "ck"),
+                                       checkpoint_async=True))
+    t = Trainer(cfg, synthetic_data=True, resume=False)
+
+    def broken_save(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(trainer_mod.ckpt, "save_checkpoint", broken_save)
+    t.save(2)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        t.join_pending_save()
