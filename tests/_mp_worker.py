@@ -12,6 +12,10 @@ Modes:
   straight  train 6 steps in one run
   part1     train 3 steps (periodic checkpoint lands at step 3), exit = "kill"
   part2     resume from the step-3 checkpoint, train to step 6
+  preempt   SIGTERM lands on process 1 ONLY mid-run; the stop flag syncs at
+            the next log boundary so BOTH processes enter the collective
+            checkpoint save together and stop at the same step (the
+            asymmetric-signal case that deadlocks naive handlers)
 
 The final-step loss of part2 must bit-exactly equal straight's.
 """
@@ -32,7 +36,9 @@ jax.config.update("jax_num_cpu_devices", 2)
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["straight", "part1", "part2"], required=True)
+    ap.add_argument(
+        "--mode", choices=["straight", "part1", "part2", "preempt"], required=True
+    )
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--num-processes", type=int, default=2)
@@ -63,17 +69,61 @@ def main() -> None:
             metrics_path="",
         )
     )
-    steps = {"straight": 6, "part1": 3, "part2": 6}[args.mode]
+    steps = {"straight": 6, "part1": 3, "part2": 6, "preempt": 20}[args.mode]
+    if args.mode == "preempt":
+        cfg = cfg.replace(
+            train=dataclasses.replace(
+                cfg.train, train_steps=20, checkpoint_interval=0, log_interval=2
+            )
+        )
     trainer = Trainer(cfg, synthetic_data=True, resume=True)
     if args.mode == "part2":
         assert trainer.start_step == 3, f"expected resume from step 3, got {trainer.start_step}"
+    if args.mode == "preempt" and args.process_id == 1:
+        # Asymmetric preemption: only THIS host gets the signal; the stop
+        # must still be collective (flag synced at log boundaries).
+        import signal
+
+        real_iter = trainer.train_iterator
+
+        class SelfSigterm:
+            def __init__(self):
+                self.n = 0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self.n += 1
+                if self.n == 5:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                return next(real_iter)
+
+            def state(self):  # keep the data-RNG sidecar flowing
+                return real_iter.state()
+
+        trainer.train_iterator = SelfSigterm()
+
+    # Record the steps THIS process actually checkpointed at — a per-process
+    # signal (the shared checkpoint dir can't distinguish divergent saves).
+    saved_steps = []
+    orig_save = trainer.save
+
+    def recording_save(step, **kw):
+        saved_steps.append(int(step))
+        return orig_save(step, **kw)
+
+    trainer.save = recording_save
     last = trainer.train(steps=steps)
 
     out = {
         "mode": args.mode,
         "process": args.process_id,
         "start_step": trainer.start_step,
-        "loss": last["loss"],
+        # preempt stops before a log boundary ever fills `last`; all other
+        # modes must still crash loudly if the loss metric goes missing.
+        "loss": last.get("loss") if args.mode == "preempt" else last["loss"],
+        "saved_steps": saved_steps,
     }
     path = os.path.join(args.workdir, f"result.{args.mode}.p{args.process_id}.json")
     with open(path, "w") as f:

@@ -101,3 +101,26 @@ def test_two_process_checkpoint_kill_resume(tmp_path):
     # bit-exactly (params + optimizer moments + per-process data RNG all
     # round-tripped through the checkpoint).
     assert resumed["loss"] == straight["loss"]
+
+
+def test_two_process_asymmetric_preemption(tmp_path):
+    """SIGTERM lands on ONE process only; the stop flag syncs at a log
+    boundary so both enter the collective checkpoint save together, stop at
+    the SAME step, and exit cleanly (no deadlock, no divergent saves)."""
+    workdir = str(tmp_path / "preempt")
+    os.makedirs(workdir)
+    _run_pair("preempt", workdir)
+
+    r0 = _result(workdir, "preempt", 0)
+    r1 = _result(workdir, "preempt", 1)
+    # Per-process save records: both processes checkpointed exactly once, at
+    # the SAME early step (a divergent stop would show different steps here
+    # even though they share the checkpoint directory).
+    assert r0["saved_steps"] == r1["saved_steps"], (r0, r1)
+    assert len(r0["saved_steps"]) == 1 and r0["saved_steps"][0] < 20, r0
+    step = r0["saved_steps"][0]
+    # Both processes wrote their shards + data-RNG sidecars at the stop step.
+    for pid in (0, 1):
+        assert os.path.exists(
+            os.path.join(workdir, "ckpt", f"step-{step}", f"local.p{pid}.json")
+        )
