@@ -162,3 +162,36 @@ def test_import_rejects_unmapped_weights():
 def test_strip_prefixes_handles_compile_of_ddp():
     sd = {"_orig_mod.module.token_embed.weight": 1, "module.x": 2, "y": 3}
     assert set(_strip_prefixes(sd)) == {"token_embed.weight", "x", "y"}
+
+
+def test_export_import_roundtrip_identity():
+    """export_params is the exact inverse of import_state_dict."""
+    from scripts.export_torch_checkpoint import export_params
+
+    sd = {
+        k: v.numpy()
+        for k, v in _make_reference_state_dict(seed=2).items()
+        if v.dtype.is_floating_point and not k.endswith(".tril")
+    }
+    cfg, params = import_state_dict(sd)
+    back = export_params(cfg, params)
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k], err_msg=k)
+    # Export also synthesizes the reference's registered buffers so its
+    # strict load_state_dict finds every key.
+    extra = set(back) - set(sd)
+    assert extra == {"pos_idxs"} | {
+        f"attn_blocks.{i}.attn.heads.{h}.tril" for i in range(L) for h in range(H)
+    }
+
+
+def test_export_rejects_non_reference_shapes():
+    from scripts.export_torch_checkpoint import export_params
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.models import transformer as tf
+
+    cfg = get_preset("tiny").model  # standard GPT-2 shape: W_O + tied head
+    params = tf.init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="reference-shaped"):
+        export_params(cfg, params)
