@@ -355,10 +355,20 @@ class Trainer:
                 off_path = False
                 if tcfg.eval_interval > 0 and (step + 1) % tcfg.eval_interval == 0:
                     val_loss = self.evaluate()
-                    last["val_loss"] = val_loss
+                    # Standard derived views of the same number: perplexity
+                    # and bits-per-token (nats -> bits) for cross-run and
+                    # cross-tokenizer comparison. 700 ~ float64 exp overflow;
+                    # past it ppl reports inf rather than a silently-wrong
+                    # clamped value.
+                    eval_metrics = {
+                        "val_loss": val_loss,
+                        "val_ppl": float(np.exp(val_loss)) if val_loss < 700 else float("inf"),
+                        "val_bits_per_token": val_loss / float(np.log(2.0)),
+                    }
+                    last.update(eval_metrics)
                     off_path = True
                     if is_host0:
-                        self.logger.log({"step": step + 1, "val_loss": val_loss})
+                        self.logger.log({"step": step + 1, **eval_metrics})
                 if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
                     off_path = True
                     # ALL processes: each writes its own shards; the barrier
