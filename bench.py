@@ -48,6 +48,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--mode", default="train", choices=["train", "decode"],
+        help="train: tokens/sec + MFU of the train step (the driver metric); "
+        "decode: KV-cached generation tokens/sec",
+    )
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
     parser.add_argument(
         "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
@@ -69,12 +74,76 @@ def parse_args(argv=None) -> argparse.Namespace:
     return parser.parse_args(argv)
 
 
+def run_decode_bench(args: argparse.Namespace) -> dict:
+    """KV-cached generation throughput: tokens/sec for batched decode.
+
+    The reference's generate re-forwards the whole window per token — O(n*T^2)
+    with no cache (SURVEY §3.2); this measures the redesigned O(n*T) path
+    (prefill + lax.scan single-token steps) end to end.
+    """
+    import jax
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.generation.generate import generate
+    from pretraining_llm_tpu.models import transformer
+
+    cfg = get_preset(args.preset).model
+    # The KV-cached forward always attends via the masked einsum path
+    # (per-step shapes are tiny; flash targets training) — --attention would
+    # be a silent no-op here, so reject it instead of mismeasuring.
+    if args.attention:
+        raise ValueError("--attention has no effect on the cached decode path")
+    if cfg.attention_impl in ("ring", "ulysses"):
+        cfg = dataclasses.replace(cfg, attention_impl="naive", sequence_parallel=False)
+    batch = args.batch or 8
+    if args.quick:
+        batch = min(batch, 4)
+    new_tokens = min(64 if args.quick else 256, cfg.context_length // 2)
+    prompt_len = min(64, cfg.context_length - new_tokens)
+    params = transformer.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+
+    def run(seed):
+        out = generate(
+            params, cfg, prompt, new_tokens, jax.random.key(seed), temperature=1.0
+        )
+        # device_get, not block_until_ready: the latter does not actually
+        # synchronize on the tunneled-TPU backend (same protocol as the
+        # train bench's loss fetch).
+        return jax.device_get(out)
+
+    run(0)  # compile + warm
+    t0 = time.perf_counter()
+    n_runs = 2 if args.quick else 4
+    for s in range(1, n_runs + 1):
+        run(s)
+    dt = (time.perf_counter() - t0) / n_runs
+    tps = batch * new_tokens / dt
+    return {
+        "metric": f"decode_tokens_per_sec_{args.preset}",
+        "value": round(tps, 1),
+        "unit": "tokens_per_sec",
+        "vs_baseline": 0.0,  # the reference publishes no decode numbers
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "ms_per_token_step": round(dt / new_tokens * 1e3, 3),
+        "attention": "naive (cached-decode path)",
+        "device": jax.devices()[0].device_kind,
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     """One in-process bench attempt. May raise / hang on backend trouble —
     the wrapper owns retries and timeouts."""
     from pretraining_llm_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+
+    if args.mode == "decode":
+        return run_decode_bench(args)
 
     import jax
     import jax.numpy as jnp
@@ -187,10 +256,14 @@ def run_bench(args: argparse.Namespace) -> dict:
 
 
 def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
+    if args.mode == "decode":
+        metric, unit = f"decode_tokens_per_sec_{args.preset}", "tokens_per_sec"
+    else:
+        metric, unit = f"mfu_{args.preset}_train", "fraction_of_peak_bf16"
     return {
-        "metric": f"mfu_{args.preset}_train",
+        "metric": metric,
         "value": 0.0,
-        "unit": "fraction_of_peak_bf16",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": msg[:800],
         "attempts": attempts,
@@ -219,6 +292,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
         ]
         if args.quick:
             cmd.append("--quick")
+        if args.mode != "train":
+            cmd += ["--mode", args.mode]
         if args.attention:
             cmd += ["--attention", args.attention]
         if args.remat:
