@@ -54,6 +54,7 @@ def parse_args(argv=None) -> argparse.Namespace:
         "decode: KV-cached generation tokens/sec",
     )
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
+    parser.add_argument("--ce", default="", choices=["", "chunked", "fused"])
     parser.add_argument(
         "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
     )
@@ -162,6 +163,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
     if args.unroll:
         model = dataclasses.replace(model, scan_unroll=args.unroll)
+    if args.ce:
+        model = dataclasses.replace(model, ce_impl=args.ce)
     if args.remat:
         model = dataclasses.replace(model, remat=args.remat)
     elif model.remat == "none":
@@ -296,6 +299,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
             cmd += ["--mode", args.mode]
         if args.attention:
             cmd += ["--attention", args.attention]
+        if args.ce:
+            cmd += ["--ce", args.ce]
         if args.remat:
             cmd += ["--remat", args.remat]
         if args.unroll:

@@ -76,6 +76,12 @@ class ModelConfig:
     # Rematerialization policy applied to each scanned block — see
     # ops/remat.py for what each saves.
     remat: str = "none"  # none | full | dots_saveable | save_attn | save_qkv_attn | save_big
+    # CE head implementation: "chunked" scans token chunks under remat
+    # (default, handles bias + vocab-sharded TP heads); "fused" runs the
+    # Pallas online-logsumexp kernel (ops/pallas_ce.py) — no logits ever
+    # reach HBM. Fused silently degrades to chunked for biased or
+    # tensor-sharded heads.
+    ce_impl: str = "chunked"  # chunked | fused
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
     scan_unroll: int = 1
@@ -114,6 +120,8 @@ class ModelConfig:
             )
         if self.remat not in _REMAT_POLICIES:
             raise ValueError(f"remat must be one of {_REMAT_POLICIES}, got {self.remat!r}")
+        if self.ce_impl not in ("chunked", "fused"):
+            raise ValueError(f"ce_impl must be 'chunked' or 'fused', got {self.ce_impl!r}")
         if self.ring_layout not in ("contiguous", "zigzag"):
             raise ValueError(
                 f"ring_layout must be 'contiguous' or 'zigzag', got {self.ring_layout!r}"

@@ -464,6 +464,42 @@ def _chunked_ce(
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t, d = hidden.shape
     s = b * t
+    if cfg.ce_impl == "fused":
+        from pretraining_llm_tpu.ops.pallas_ce import fused_cross_entropy
+
+        mesh = current_mesh()
+        # GSPMD can't partition a pallas_call: without handling it would
+        # REPLICATE the kernel (all-gathering the global batch onto every
+        # device). Batch-sharded meshes get an explicit shard_map over the
+        # batch axes (W replicated, per-shard kernel); vocab-sharded (tensor)
+        # and seq/pipe-sharded hidden layouts fall back to chunked CE.
+        nontrivial = lambda ax: mesh.shape.get(ax, 1) > 1 if mesh is not None else False
+        if bias is None and not any(nontrivial(ax) for ax in ("tensor", "seq", "pipe")):
+            hidden_c = hidden.astype(cdt)
+            w_c = w_out.astype(cdt)
+            if mesh is not None and (nontrivial("data") or nontrivial("fsdp")):
+                from jax.sharding import PartitionSpec as P
+
+                batch_axes = ("data", "fsdp")
+
+                def local_ce(h_l, w_l, t_l):
+                    bl, tl, dl = h_l.shape
+                    return fused_cross_entropy(
+                        h_l.reshape(bl * tl, dl), w_l, t_l.reshape(bl * tl)
+                    ).reshape(bl, tl)
+
+                losses = jax.shard_map(
+                    local_ce,
+                    mesh=mesh,
+                    in_specs=(P(batch_axes, None, None), P(None, None), P(batch_axes, None)),
+                    out_specs=P(batch_axes, None),
+                    check_vma=False,
+                )(hidden_c, w_c, targets)
+            else:
+                losses = fused_cross_entropy(
+                    hidden_c.reshape(s, d), w_c, targets.reshape(s)
+                )
+            return jnp.mean(losses)
     # Chunk only when the fp32 logits buffer is big enough to matter (XLA
     # already fuses the small-head case well — measured neutral-to-slower to
     # chunk at GPT-2 batch sizes). Target <= ~512 MB per chunk.
