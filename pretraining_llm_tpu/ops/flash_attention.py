@@ -107,6 +107,39 @@ def _pallas_available() -> bool:
         return False
 
 
+def shard_mapped_kernel(kernel, q, k, v, mesh, *, batch_axes=("data", "fsdp")):
+    """Run an attention kernel per-shard under a batch/head-sharded mesh.
+
+    GSPMD cannot partition a pallas_call — traced directly on sharded
+    operands it would REPLICATE the kernel, all-gathering the global batch
+    onto every device. This wraps it in a shard_map over the batch axes
+    (+ 'tensor' on the head dim when the head counts divide).
+
+    Returns None when the layout isn't expressible per-shard (head counts
+    not divisible by the tensor axis; seq/pipe-sharded activations belong to
+    the ring/ulysses/pipeline paths) — caller falls back.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if any(mesh.shape.get(ax, 1) > 1 for ax in ("seq", "pipe")):
+        return None
+    batch_shards = 1
+    for ax in batch_axes:
+        batch_shards *= mesh.shape.get(ax, 1)
+    if q.shape[0] % batch_shards != 0:
+        return None  # small/partial batch: let the caller's fallback handle it
+    h, g = q.shape[2], k.shape[2]
+    tp = mesh.shape.get("tensor", 1)
+    if tp > 1 and (h % tp != 0 or g % tp != 0):
+        return None
+    head_ax = "tensor" if tp > 1 else None
+    spec = P(batch_axes, None, head_ax, None)
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -130,10 +163,33 @@ def flash_attention(
     if _pallas_available():
         try:
             from pretraining_llm_tpu.ops.pallas_flash import pallas_flash_attention
+            from pretraining_llm_tpu.parallel.sharding import current_mesh
 
-            return pallas_flash_attention(
-                q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+            kernel = functools.partial(
+                pallas_flash_attention, causal=causal, block_q=block_q,
+                block_kv=block_kv,
             )
+            mesh = current_mesh()
+            # Inside an already-manual shard_map region (ulysses' all-to-all
+            # body, the pipeline's pipe region) the operands are per-device
+            # local arrays — the direct kernel call is the correct path even
+            # though the *installed* mesh still shows sharded axes.
+            in_manual_region = any(
+                t == jax.sharding.AxisType.Manual
+                for t in jax.sharding.get_abstract_mesh().axis_types
+            )
+            if (
+                mesh is None
+                or in_manual_region
+                or all(s == 1 for s in mesh.shape.values())
+            ):
+                return kernel(q, k, v)
+            out = shard_mapped_kernel(kernel, q, k, v, mesh)
+            if out is not None:
+                return out
+            # Unexpressible per-shard layout (seq/pipe-sharded activations,
+            # indivisible batch or heads): blockwise fallback below — GSPMD
+            # partitions plain JAX ops fine.
         except ImportError:
             pass  # kernel module not built yet; blockwise path is correct
     if gqa:

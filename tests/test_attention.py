@@ -89,3 +89,45 @@ def test_bf16_inputs_fp32_softmax():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
     )
+
+
+def test_shard_mapped_flash_kernel_matches_dense(mesh8):
+    """The pallas kernel wrapped per-shard over (data, fsdp, tensor) ==
+    dense attention — and incompatible layouts return None (fallback)."""
+    import functools
+
+    from pretraining_llm_tpu.ops.flash_attention import shard_mapped_kernel
+    from pretraining_llm_tpu.ops.pallas_flash import pallas_flash_attention
+
+    b, t, h, dh = 4, 32, 4, 8
+    ks = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(ks[0], (b, t, h, dh), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, h, dh), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, h, dh), jnp.float32)
+    kernel = functools.partial(
+        pallas_flash_attention, causal=True, block_q=16, block_kv=16,
+        interpret=True,
+    )
+    got = jax.jit(
+        lambda q, k, v: shard_mapped_kernel(kernel, q, k, v, mesh8)
+    )(q, k, v)
+    want = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    # Head count not divisible by the tensor axis -> None (caller falls back).
+    q3 = q[:, :, :3]
+    assert shard_mapped_kernel(kernel, q3, k[:, :, :3], v[:, :, :3], mesh8) is None
+
+
+def test_shard_mapped_kernel_rejects_indivisible_batch(mesh8):
+    """Batch not divisible by the data x fsdp shards -> None (fallback),
+    never a shard_map trace error."""
+    import functools
+
+    from pretraining_llm_tpu.ops.flash_attention import shard_mapped_kernel
+    from pretraining_llm_tpu.ops.pallas_flash import pallas_flash_attention
+
+    ks = jax.random.split(jax.random.key(12), 3)
+    q, k, v = (jax.random.normal(kk, (2, 32, 4, 8), jnp.float32) for kk in ks)
+    kernel = functools.partial(pallas_flash_attention, causal=True, interpret=True)
+    assert shard_mapped_kernel(kernel, q, k, v, mesh8) is None  # 2 % 4 != 0
