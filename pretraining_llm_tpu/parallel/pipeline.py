@@ -32,7 +32,6 @@ PP x TP x DP 3-D parallelism from one schedule.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Tuple
 
 import jax
