@@ -12,9 +12,12 @@ transient UNAVAILABLE errors or hang outright during init. JAX caches a failed
 backend for the life of the process, so retrying in-process is useless —
 instead the default entry point is a thin wrapper that re-execs itself with
 ``--_inner`` per attempt, each attempt a fresh process under a hard timeout,
-with exponential backoff between attempts until ``--timeout-budget`` seconds
-are spent. On final failure it prints a structured JSON error line (never a
-traceback) so the driver always gets parseable output.
+with exponential backoff on transient failures until ``--timeout-budget``
+seconds are spent. A default gpt2-124m train run additionally RACES an
+ordered remat-candidate list (newest policy first, proven-safe last, each
+with a reserved share of the budget) and reports the best success. On final
+failure it prints a structured JSON error line (never a traceback) so the
+driver always gets parseable output.
 
 Usage:
   python bench.py             # full run (gpt2-124m, auto batch)
@@ -252,6 +255,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         "context_length": model.context_length,
         "params_m": round(model.num_params() / 1e6, 1),
         "attention": model.attention_impl,
+        "remat": model.remat,
+        "ce_impl": model.ce_impl,
         "device": jax.devices()[0].device_kind,
         "n_devices": n_dev,
         "loss_finite": bool(jnp.isfinite(loss_v)),
@@ -273,80 +278,116 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
     }
 
 
+def _attempt(args: argparse.Namespace, remat: str, timeout: float):
+    """One fresh-subprocess inner run. Returns (json_dict|None, err_str)."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--_inner",
+        "--preset", args.preset,
+        "--batch", str(args.batch),
+        "--steps", str(args.steps),
+        "--warmup", str(args.warmup),
+    ]
+    if args.quick:
+        cmd.append("--quick")
+    if args.mode != "train":
+        cmd += ["--mode", args.mode]
+    if args.attention:
+        cmd += ["--attention", args.attention]
+    if args.ce:
+        cmd += ["--ce", args.ce]
+    if remat:
+        cmd += ["--remat", remat]
+    if args.unroll:
+        cmd += ["--unroll", str(args.unroll)]
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout, text=True
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"hung past {timeout:.0f}s (killed)"
+    out_lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if not out_lines:
+        return None, f"rc={proc.returncode}: (no output)"
+    try:
+        rec = json.loads(out_lines[-1])
+    except json.JSONDecodeError:
+        return None, f"rc={proc.returncode}: non-JSON output: {out_lines[-1][:200]}"
+    if proc.returncode == 0:
+        return rec, ""
+    # Parseable structured error from the inner run: hand it back so the
+    # caller can relay the full diagnostic rather than a truncated tail.
+    return rec, f"rc={proc.returncode}: {out_lines[-1][:300]}"
+
+
 def wrapper_main(args: argparse.Namespace) -> int:
-    """Retry loop: fresh subprocess per attempt (JAX pins a failed backend for
-    the whole process), hard per-attempt timeout (init can hang, not just
-    raise), exponential backoff, structured JSON error on final failure."""
+    """Candidate-racing retry loop.
+
+    Fresh subprocess per attempt (JAX pins a failed backend for the whole
+    process), hard per-attempt timeout (init can hang, not just raise),
+    structured JSON error on final failure. When no explicit --remat is
+    given for a train run, races an ordered remat-candidate list — the
+    newest (fastest-expected) policy first, the proven-safe one last — and
+    reports the BEST successful result: a policy that trips a compiler
+    pathology costs one bounded attempt, never the round's number.
+    """
     deadline = time.monotonic() + args.timeout_budget
-    backoff = 10.0
+    # Race only on the preset the candidate list was measured at; every
+    # other preset keeps its own tuned remat (passed through untouched).
+    race = (
+        not args.remat
+        and args.mode == "train"
+        and not args.quick
+        and args.preset == "gpt2-124m"
+    )
+    candidates = ["save_big", "save_attn"] if race else [args.remat]
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
-    while True:
+    best = None
+    last_error_rec = None
+    transient_markers = (
+        "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
+        "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
+    )
+    for ci, remat in enumerate(candidates):
+        # Reserve budget up front: a pathological first candidate may spend
+        # at most its fair share, never the safe fallback's.
         remaining = deadline - time.monotonic()
-        if remaining <= 5:
-            break
-        attempts += 1
-        cmd = [
-            sys.executable, os.path.abspath(__file__), "--_inner",
-            "--preset", args.preset,
-            "--batch", str(args.batch),
-            "--steps", str(args.steps),
-            "--warmup", str(args.warmup),
-        ]
-        if args.quick:
-            cmd.append("--quick")
-        if args.mode != "train":
-            cmd += ["--mode", args.mode]
-        if args.attention:
-            cmd += ["--attention", args.attention]
-        if args.ce:
-            cmd += ["--ce", args.ce]
-        if args.remat:
-            cmd += ["--remat", args.remat]
-        if args.unroll:
-            cmd += ["--unroll", str(args.unroll)]
-        try:
-            proc = subprocess.run(
-                cmd,
-                stdout=subprocess.PIPE,
-                stderr=sys.stderr,
-                timeout=min(args.attempt_timeout, remaining),
-                text=True,
+        cand_deadline = time.monotonic() + remaining / (len(candidates) - ci)
+        backoff = 10.0
+        while True:
+            remaining = cand_deadline - time.monotonic()
+            if remaining <= 5:
+                break
+            attempts += 1
+            rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining))
+            if rec is not None and not err:
+                if best is None or rec.get("value", 0) > best.get("value", 0):
+                    best = rec
+                break  # this candidate succeeded; next candidate
+            last_err = f"attempt {attempts} (remat={remat or 'default'}): {err}"
+            if rec is not None:
+                last_error_rec = rec
+            print(f"[bench] {last_err}", file=sys.stderr)
+            # Hangs while racing are treated as deterministic (the known
+            # compile-pathology mode) — move to the safe candidate instead
+            # of re-burning the reserved budget; single-candidate runs keep
+            # retrying hangs (tunnel flakes) until the budget runs out.
+            transient = any(m in err for m in transient_markers) or (
+                "hung" in err and not race
             )
-        except subprocess.TimeoutExpired:
-            last_err = f"attempt {attempts} hung past {args.attempt_timeout:.0f}s (killed)"
-            print(f"[bench] {last_err}; retrying", file=sys.stderr)
-            continue
-        out_lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
-        if proc.returncode == 0 and out_lines:
-            # Relay the inner run's final JSON line untouched.
-            try:
-                json.loads(out_lines[-1])
-                print(out_lines[-1])
-                return 0
-            except json.JSONDecodeError:
-                last_err = f"attempt {attempts}: non-JSON output: {out_lines[-1][:200]}"
-        else:
-            tail = out_lines[-1][:300] if out_lines else "(no output)"
-            last_err = f"attempt {attempts}: rc={proc.returncode}: {tail}"
-            # A deterministic error (bad flag, import error, ...) won't heal
-            # with retries — relay it now. Only backend/transport flakes loop.
-            transient_markers = (
-                "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
-                "Socket", "socket", "connect", "RESOURCE_EXHAUSTED", "hung",
-            )
-            if out_lines and not any(m in tail for m in transient_markers):
-                try:
-                    json.loads(out_lines[-1])
-                    print(out_lines[-1])
-                    return 1
-                except json.JSONDecodeError:
-                    pass
-        print(f"[bench] {last_err}; backing off {backoff:.0f}s", file=sys.stderr)
-        if time.monotonic() + backoff >= deadline:
-            break
-        time.sleep(backoff)
-        backoff = min(backoff * 2, 120.0)
+            if not transient:
+                break
+            if time.monotonic() + backoff >= cand_deadline:
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 120.0)
+    if best is not None:
+        print(json.dumps(best))
+        return 0
+    if last_error_rec is not None and not race:
+        # Relay the inner run's full structured error line untouched.
+        print(json.dumps(last_error_rec))
+        return 1
     print(json.dumps(error_result(args, last_err, attempts)))
     return 1
 
