@@ -1,6 +1,7 @@
 """Generation: cached decode == uncached forward, sampling semantics, CLI path."""
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -181,3 +182,46 @@ def test_moe_generation_not_bucketed_and_matches_reference():
         logits, _ = transformer.forward(params, jnp.asarray(seq), cfg)
         seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
     np.testing.assert_array_equal(got, seq[:, 17:])
+
+
+def test_evaluate_cli(tmp_path):
+    """Train briefly, then the standalone eval CLI reports a sane loss and
+    is deterministic across invocations."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckdir = str(tmp_path / "ck")
+    # Real-ish token file: biased byte stream (so val loss < ln(256)).
+    rng = np.random.default_rng(0)
+    tokens = rng.choice(64, size=80_000).astype(np.uint16)
+    data = tmp_path / "val.bin"
+    tokens.tofile(data)
+
+    env = dict(os.environ, PLLM_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "train.py"),
+         "--preset", "tiny", "--no-resume",
+         "--override", "train.train_steps=30", "train.checkpoint_interval=30",
+         "train.eval_interval=0", f"train.checkpoint_dir={ckdir}",
+         f"data.train_path={data}", f"data.val_path={data}"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    def run_eval():
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "evaluate.py"),
+             "--model_path", ckdir, "--data", str(data), "--iters", "4"],
+            capture_output=True, text=True, env=env, timeout=600, cwd=repo,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    a, b = run_eval(), run_eval()
+    assert a["val_loss"] == b["val_loss"]  # deterministic eval set
+    assert 0 < a["val_loss"] < 6.0
+    assert abs(a["val_ppl"] - np.exp(a["val_loss"])) < 1e-2 * a["val_ppl"]
