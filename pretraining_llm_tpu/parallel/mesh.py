@@ -46,6 +46,25 @@ def initialize_distributed() -> None:
             pass  # already initialized (e.g. called twice)
 
 
+def needs_mesh(mesh_config) -> bool:
+    """Whether training must build a device mesh: more than one device, or
+    any configured mesh axis > 1 (single source of truth — the Trainer and
+    scripts/train.py --compile-only must agree, or the preflight validates a
+    different program than the run executes)."""
+    import jax as _jax
+
+    return _jax.device_count() > 1 or any(
+        s > 1
+        for s in (
+            mesh_config.fsdp,
+            mesh_config.tensor,
+            mesh_config.seq,
+            mesh_config.expert,
+            mesh_config.pipe,
+        )
+    )
+
+
 def build_mesh(
     mesh_config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:

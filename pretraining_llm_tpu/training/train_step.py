@@ -77,10 +77,8 @@ def _loss_and_metrics(params, xb, yb, model_cfg):
     return loss
 
 
-def build_train_step(
-    cfg: Config, mesh: Optional[Mesh] = None
-) -> Callable[[TrainState, Tuple[jax.Array, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
-    """Compile the train step. batch: (x, y) each (B, T) int32, B = global batch."""
+def _make_step_fn(cfg: Config):
+    """The raw (unjitted) SPMD step: grads -> clip -> AdamW -> metrics."""
     model_cfg = cfg.model
     tcfg = cfg.train
     n_micro = tcfg.microbatches
@@ -123,6 +121,16 @@ def build_train_step(
         metrics = {"loss": loss, "grad_norm": grad_norm, "lr": lr}
         return new_state, metrics
 
+    return step_fn
+
+
+def build_train_step(
+    cfg: Config, mesh: Optional[Mesh] = None
+) -> Callable[[TrainState, Tuple[jax.Array, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Compile the train step. batch: (x, y) each (B, T) int32, B = global batch."""
+    model_cfg = cfg.model
+    step_fn = _make_step_fn(cfg)
+
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=0)
 
@@ -152,6 +160,38 @@ def build_train_step(
         return fn(state, batch)
 
     return wrapper
+
+
+def lower_train_step(cfg: Config, mesh: Optional[Mesh] = None):
+    """AOT-lower the EXACT jitted train-step program (same in/out shardings,
+    same donation) from shape specs alone — no params materialize, no data
+    loads. Returns the jax.stages.Lowered; `.compile().memory_analysis()`
+    gives XLA's per-device memory breakdown (scripts/train.py --compile-only
+    uses this to size big configs before burning pod time on an OOM)."""
+    state_shapes = jax.eval_shape(lambda: init_train_state(cfg, jax.random.key(0)))
+    b, t = cfg.train.batch_size, cfg.model.context_length
+    if mesh is None:
+        step = build_train_step(cfg, None)
+        batch_sds = jax.ShapeDtypeStruct((b, t), jnp.int32)
+        return step.lower(state_shapes, (batch_sds, batch_sds))
+    batch_sharding = NamedSharding(mesh, batch_pspec(cfg.model.sequence_parallel))
+    state_shardings = named_sharding_tree(
+        mesh, state_pspec_tree(state_shapes, _is_pipelined(cfg, mesh))
+    )
+    step_fn = _make_step_fn(cfg)
+
+    def traced(state, batch):
+        with activation_mesh(mesh):
+            return step_fn(state, batch)
+
+    fn = jax.jit(
+        traced,
+        in_shardings=(state_shardings, (batch_sharding, batch_sharding)),
+        out_shardings=(state_shardings, None),
+        donate_argnums=0,
+    )
+    batch_sds = jax.ShapeDtypeStruct((b, t), jnp.int32, sharding=batch_sharding)
+    return fn.lower(state_shapes, (batch_sds, batch_sds))
 
 
 def build_eval_step(

@@ -51,17 +51,11 @@ class Trainer:
             from pretraining_llm_tpu.utils.debug import enable_nan_checks
 
             enable_nan_checks()
-        needs_mesh = jax.device_count() > 1 or any(
-            s > 1
-            for s in (
-                config.mesh.fsdp,
-                config.mesh.tensor,
-                config.mesh.seq,
-                config.mesh.expert,
-                config.mesh.pipe,
-            )
+        from pretraining_llm_tpu.parallel.mesh import needs_mesh
+
+        self.mesh = mesh if mesh is not None else (
+            build_mesh(config.mesh) if needs_mesh(config.mesh) else None
         )
-        self.mesh = mesh if mesh is not None else (build_mesh(config.mesh) if needs_mesh else None)
         self.logger = logger or MetricsLogger(config.train.metrics_path)
         self.step_fn = ts.build_train_step(config, self.mesh)
         self.eval_loop = ts.build_eval_loop(config, self.mesh)

@@ -62,16 +62,59 @@ def main() -> None:
     )
     parser.add_argument("--no-resume", action="store_true", help="ignore existing checkpoints")
     parser.add_argument("--steps", type=int, default=None, help="override total steps")
+    parser.add_argument(
+        "--compile-only", action="store_true",
+        help="compile the train step, print per-device memory analysis "
+        "(size a big config BEFORE burning pod time on an OOM), and exit",
+    )
     args = parser.parse_args()
 
     config = get_preset(args.preset).with_overrides(parse_overrides(args.override))
     if jax.process_index() == 0:
         print(f"preset={config.name} devices={jax.device_count()} "
               f"params={config.model.num_params()/1e6:.1f}M")
+    if args.compile_only:
+        compile_only(config)
+        return
     trainer = Trainer(config, synthetic_data=(args.data == "synthetic"), resume=not args.no_resume)
     final = trainer.train(steps=args.steps)
     if jax.process_index() == 0:
         print("final:", final)
+
+
+def compile_only(config) -> None:
+    """AOT-compile the exact training program from shape specs only — no
+    params materialize, no data loads — and report XLA's per-device memory
+    breakdown (donated/aliased state buffers counted once)."""
+    import json as _json
+    import time as _time
+
+    from pretraining_llm_tpu.parallel.mesh import build_mesh, needs_mesh
+    from pretraining_llm_tpu.training import train_step as ts
+
+    mesh = build_mesh(config.mesh) if needs_mesh(config.mesh) else None
+    t0 = _time.time()
+    compiled = ts.lower_train_step(config, mesh).compile()
+    dt = _time.time() - t0
+    mem = compiled.memory_analysis()
+    gib = 2**30
+    alias = getattr(mem, "alias_size_in_bytes", 0)
+    report = {
+        "compile_s": round(dt, 1),
+        "devices": jax.device_count(),
+        "per_device_GiB": {
+            "arguments": round(mem.argument_size_in_bytes / gib, 3),
+            "outputs": round(mem.output_size_in_bytes / gib, 3),
+            "aliased (donated state, counted once)": round(alias / gib, 3),
+            "temps": round(mem.temp_size_in_bytes / gib, 3),
+            "total_peak_estimate": round(
+                (mem.argument_size_in_bytes + mem.output_size_in_bytes
+                 + mem.temp_size_in_bytes - alias) / gib, 3,
+            ),
+        },
+    }
+    if jax.process_index() == 0:
+        print(_json.dumps(report))
 
 
 if __name__ == "__main__":
