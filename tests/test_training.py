@@ -246,3 +246,13 @@ def test_loader_minimum_size_shard():
     x, y = next(it)
     _np.testing.assert_array_equal(x, _np.tile(_np.arange(16), (4, 1)))
     _np.testing.assert_array_equal(y, _np.tile(_np.arange(1, 17), (4, 1)))
+
+
+def test_lower_train_step_memory_analysis():
+    """The AOT preflight lowers/compiles from shape specs alone and exposes
+    a readable memory analysis (scripts/train.py --compile-only contract)."""
+    cfg = _tiny_config(train_steps=1)
+    compiled = ts.lower_train_step(cfg, mesh=None).compile()
+    mem = compiled.memory_analysis()
+    assert mem.temp_size_in_bytes >= 0
+    assert mem.argument_size_in_bytes >= 0
