@@ -13,11 +13,18 @@ backend for the life of the process, so retrying in-process is useless —
 instead the default entry point is a thin wrapper that re-execs itself with
 ``--_inner`` per attempt, each attempt a fresh process under a hard timeout,
 with exponential backoff on transient failures until ``--timeout-budget``
-seconds are spent. A default gpt2-124m train run additionally RACES an
-ordered remat-candidate list (newest policy first, proven-safe last, each
-with a reserved share of the budget) and reports the best success. On final
-failure it prints a structured JSON error line (never a traceback) so the
-driver always gets parseable output.
+seconds are spent. Self-diagnosis (VERDICT r2 #1): before any budget is
+spent, a 1-matmul CANARY subprocess classifies the environment — a dead
+tunnel emits ``{"error": "environment: backend unreachable", ...,
+"environment_error": true}`` instead of an unattributable hang; the inner
+run stamps phases to stderr (backend up → state built → compile → steps) so
+a killed attempt names its phase. A default gpt2-124m train run RACES an
+ordered candidate list — newest remat policy first, then the proven-safe
+ladder (``full`` remat, finally ``--attention naive``) with reserved budget
+shares — and reports the best success: one pathological policy can cost a
+bounded attempt, never the round's number. On final failure it prints a
+structured JSON error line (never a traceback) so the driver always gets
+parseable output.
 
 Usage:
   python bench.py             # full run (gpt2-124m, auto batch)
@@ -52,9 +59,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
-        "--mode", default="train", choices=["train", "decode"],
+        "--mode", default="train", choices=["train", "decode", "trainer"],
         help="train: tokens/sec + MFU of the train step (the driver metric); "
-        "decode: KV-cached generation tokens/sec",
+        "decode: KV-cached generation tokens/sec; trainer: the FULL Trainer "
+        "loop incl. the input pipeline (measures host-sampling overlap — "
+        "compare --prefetch 0 vs 2)",
+    )
+    parser.add_argument(
+        "--prefetch", type=int, default=-1,
+        help="trainer mode: data.prefetch depth override (-1 = preset value)",
     )
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
     parser.add_argument("--ce", default="", choices=["", "chunked", "fused"])
@@ -75,7 +88,55 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="hard wall-clock cap for a single attempt (compile can take minutes on TPU)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument(
+        "--canary-timeout",
+        type=float,
+        default=150.0,
+        help="seconds the 1-matmul environment canary may take before the "
+        "backend is declared unreachable (first TPU compile ~20-40s)",
+    )
+    parser.add_argument(
+        "--skip-canary", action="store_true",
+        help="skip the environment canary (e.g. on a known-good local backend)",
+    )
     return parser.parse_args(argv)
+
+
+def _stamp(msg: str) -> None:
+    """Phase stamp to stderr: a killed attempt is attributable to a phase
+    (backend init vs compile vs steps), and a dead tunnel is distinguishable
+    from a framework regression (VERDICT r2 weak #1)."""
+    print(f"[bench-inner {time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
+def canary_main() -> int:
+    """Minimal environment probe: acquire the backend, jit ONE matmul.
+
+    Success proves the tunnel/backend is alive and compiles run; any hang or
+    error here is an ENVIRONMENT failure, not a framework regression. Runs in
+    its own subprocess (JAX pins a failed backend for the process lifetime).
+    """
+    from pretraining_llm_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    _stamp("canary: importing jax")
+    import jax
+    import jax.numpy as jnp
+
+    _stamp("canary: acquiring devices")
+    devs = jax.devices()
+    _stamp(f"canary: backend up: {jax.default_backend()} x{len(devs)} ({devs[0].device_kind})")
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    val = float(jax.device_get(y[0, 0]))
+    _stamp(f"canary: matmul done ({val})")
+    print(json.dumps({"ok": True, "platform": jax.default_backend(),
+                      "device": devs[0].device_kind, "n_devices": len(devs)}))
+    return 0
 
 
 def run_decode_bench(args: argparse.Namespace) -> dict:
@@ -139,6 +200,88 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
     }
 
 
+def run_trainer_bench(args: argparse.Namespace) -> dict:
+    """Tokens/sec of the FULL Trainer loop (synthetic data): step dispatch +
+    host sampling + H2D, i.e. what the train CLI actually sustains. The
+    delta between --prefetch 0 and --prefetch 2 is the input-pipeline
+    overlap win (VERDICT r2 #8's queued on-chip measurement)."""
+    import dataclasses as dc
+
+    import jax
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.training.trainer import Trainer
+    from pretraining_llm_tpu.utils.hardware import device_peak_flops
+
+    cfg = get_preset(args.preset)
+    model = cfg.model
+    if model.attention_impl == "ring":
+        model = dc.replace(model, attention_impl="flash", sequence_parallel=False)
+    if args.remat:
+        model = dc.replace(model, remat=args.remat)
+    elif model.remat == "none":
+        model = dc.replace(model, remat="save_attn")
+    if args.ce:
+        model = dc.replace(model, ce_impl=args.ce)
+    batch = args.batch or (24 if args.preset == "gpt2-124m" else cfg.train.batch_size)
+    steps = 8 if args.quick else max(args.steps, 10)
+    if args.quick:
+        batch = min(batch, 4)
+    data = cfg.data
+    if args.prefetch >= 0:
+        data = dc.replace(data, prefetch=args.prefetch)
+    import tempfile
+
+    cfg = cfg.replace(
+        model=model,
+        data=data,
+        train=dc.replace(
+            cfg.train,
+            batch_size=batch,
+            train_steps=steps,
+            checkpoint_interval=0,
+            # No end-of-run checkpoint: a synchronous full-state write would
+            # land INSIDE the timed region (swamping the prefetch delta this
+            # mode measures) and leave resumable bench state behind.
+            save_final=False,
+            checkpoint_dir=tempfile.mkdtemp(prefix="bench_trainer_"),
+            eval_interval=0,
+            log_interval=max(steps // 2, 1),
+            metrics_path="",
+        ),
+    )
+    _stamp(f"trainer bench: prefetch={cfg.data.prefetch}, batch={batch}, steps={steps}")
+
+    class _Quiet:
+        def log(self, rec):
+            pass
+
+    t = Trainer(cfg, synthetic_data=True, resume=False, logger=_Quiet())
+    _stamp("trainer built; warm step + compile")
+    t.train(steps=max(2, steps // 4))  # compile + warm
+    _stamp("warm done; timing full loop")
+    t0 = time.perf_counter()
+    last = t.train(steps=steps)
+    # The loop's last logged metrics already synced the device.
+    dt = time.perf_counter() - t0
+    tok_per_sec = batch * model.context_length * steps / dt
+    n_dev = jax.device_count()
+    mfu = tok_per_sec * model.flops_per_token() / (device_peak_flops() * n_dev)
+    return {
+        "metric": f"trainer_tokens_per_sec_{cfg.name}",
+        "value": round(tok_per_sec / n_dev, 1),
+        "unit": "tokens_per_sec_chip",
+        "vs_baseline": 0.0,
+        "mfu": round(mfu, 4),
+        "prefetch": cfg.data.prefetch,
+        "batch": batch,
+        "steps": steps,
+        "loss_finite": bool(last.get("loss", 0.0) == last.get("loss", 0.0)) if last else True,
+        "device": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     """One in-process bench attempt. May raise / hang on backend trouble —
     the wrapper owns retries and timeouts."""
@@ -148,7 +291,10 @@ def run_bench(args: argparse.Namespace) -> dict:
 
     if args.mode == "decode":
         return run_decode_bench(args)
+    if args.mode == "trainer":
+        return run_trainer_bench(args)
 
+    _stamp("importing jax")
     import jax
     import jax.numpy as jnp
 
@@ -185,12 +331,17 @@ def run_bench(args: argparse.Namespace) -> dict:
         args.steps, args.warmup, batch = 5, 2, min(batch, 4)
     cfg = cfg.replace(model=model, train=dataclasses.replace(cfg.train, batch_size=batch))
 
-    n_dev = jax.device_count()
+    n_dev = jax.device_count()  # first device touch: backend init happens HERE
+    _stamp(f"backend up: {jax.default_backend()} x{n_dev} ({jax.devices()[0].device_kind})")
     mesh = build_mesh(cfg.mesh) if n_dev > 1 else None
     state = ts.init_train_state(cfg, jax.random.key(0))
     if mesh is not None:
-        state = ts.shard_train_state(state, mesh)
+        # cfg is REQUIRED here: it decides the baked interleaved-PP layout
+        # that build_train_step(cfg, mesh) will assume.
+        state = ts.shard_train_state(state, mesh, cfg)
     step = ts.build_train_step(cfg, mesh)
+    _stamp(f"state built (remat={model.remat}, attn={model.attention_impl}, "
+           f"ce={model.ce_impl}, batch={batch})")
 
     it = loader.synthetic_iterator(model.vocab_size, model.context_length, batch, seed=0)
     x, y = next(it)
@@ -218,13 +369,17 @@ def run_bench(args: argparse.Namespace) -> dict:
     run1, run2 = make_runner(n1), make_runner(n2)
 
     # Compile + warm both programs.
+    _stamp(f"compile start (scan lengths {n1}, {n2})")
     state, loss = run1(state, batch_dev)
     float(jax.device_get(loss))
+    _stamp(f"compile 1/2 done + {n1} steps ran")
     state, loss = run2(state, batch_dev)
     float(jax.device_get(loss))
+    _stamp(f"compile 2/2 done + {n2} steps ran")
     for _ in range(max(args.warmup - 1, 0)):
         state, loss = run1(state, batch_dev)
         float(jax.device_get(loss))
+    _stamp("warmup done; timing")
 
     t0 = time.perf_counter()
     state, loss = run1(state, batch_dev)
@@ -278,7 +433,26 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
     }
 
 
-def _attempt(args: argparse.Namespace, remat: str, timeout: float):
+def _run_canary(timeout: float):
+    """Probe the environment in a fresh subprocess. Returns (ok, detail)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_canary"]
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout, text=True
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"canary hung past {timeout:.0f}s (backend unreachable)"
+    lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if proc.returncode == 0 and lines:
+        try:
+            return True, json.loads(lines[-1])
+        except json.JSONDecodeError:
+            pass
+    tail = lines[-1][:200] if lines else "(no output)"
+    return False, f"canary failed rc={proc.returncode}: {tail}"
+
+
+def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: str = ""):
     """One fresh-subprocess inner run. Returns (json_dict|None, err_str)."""
     cmd = [
         sys.executable, os.path.abspath(__file__), "--_inner",
@@ -291,8 +465,10 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float):
         cmd.append("--quick")
     if args.mode != "train":
         cmd += ["--mode", args.mode]
-    if args.attention:
-        cmd += ["--attention", args.attention]
+    if args.prefetch >= 0:
+        cmd += ["--prefetch", str(args.prefetch)]
+    if args.attention or attention:
+        cmd += ["--attention", args.attention or attention]
     if args.ce:
         cmd += ["--ce", args.ce]
     if remat:
@@ -331,15 +507,47 @@ def wrapper_main(args: argparse.Namespace) -> int:
     pathology costs one bounded attempt, never the round's number.
     """
     deadline = time.monotonic() + args.timeout_budget
+
+    # Environment canary FIRST (VERDICT r2 next #1b): a dead tunnel must be
+    # distinguishable from a framework regression, and must not burn the
+    # whole budget. One retry — a single canary hang could still be a flake.
+    canary_info = None
+    if not args.skip_canary:
+        for i in range(2):
+            t_c = time.monotonic()
+            ok, detail = _run_canary(args.canary_timeout)
+            if ok:
+                canary_info = detail
+                canary_info["canary_s"] = round(time.monotonic() - t_c, 1)
+                print(f"[bench] canary ok: {json.dumps(detail)}", file=sys.stderr)
+                break
+            print(f"[bench] {detail} (try {i + 1}/2)", file=sys.stderr)
+        else:
+            rec = error_result(args, f"environment: backend unreachable ({detail})", 0)
+            rec["environment_error"] = True
+            print(json.dumps(rec))
+            return 1
+
     # Race only on the preset the candidate list was measured at; every
     # other preset keeps its own tuned remat (passed through untouched).
     race = (
         not args.remat
+        and not args.attention
         and args.mode == "train"
         and not args.quick
         and args.preset == "gpt2-124m"
     )
-    candidates = ["save_big", "save_attn"] if race else [args.remat]
+    if race:
+        # (remat, attention) candidates, newest policy first. The tail is
+        # the KNOWN-GOOD ladder (VERDICT r2 next #1c): 'full' remat + flash
+        # is the round-1-measured-safe config, and naive attention last —
+        # a Mosaic pathology in the new policies can cost bounded attempts,
+        # never the round's number.
+        candidates = [
+            ("save_big", ""), ("save_attn", ""), ("full", ""), ("full", "naive"),
+        ]
+    else:
+        candidates = [(args.remat, "")]
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
     best = None
@@ -348,7 +556,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
         "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
         "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
     )
-    for ci, remat in enumerate(candidates):
+    for ci, (remat, attention) in enumerate(candidates):
         # Reserve budget up front: a pathological first candidate may spend
         # at most its fair share, never the safe fallback's.
         remaining = deadline - time.monotonic()
@@ -359,12 +567,16 @@ def wrapper_main(args: argparse.Namespace) -> int:
             if remaining <= 5:
                 break
             attempts += 1
-            rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining))
+            rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining), attention)
             if rec is not None and not err:
                 if best is None or rec.get("value", 0) > best.get("value", 0):
                     best = rec
                 break  # this candidate succeeded; next candidate
-            last_err = f"attempt {attempts} (remat={remat or 'default'}): {err}"
+            last_err = (
+                f"attempt {attempts} (remat={remat or 'default'}"
+                + (f", attention={attention}" if attention else "")
+                + f"): {err}"
+            )
             if rec is not None:
                 last_error_rec = rec
             print(f"[bench] {last_err}", file=sys.stderr)
@@ -381,11 +593,16 @@ def wrapper_main(args: argparse.Namespace) -> int:
                 break
             time.sleep(backoff)
             backoff = min(backoff * 2, 120.0)
+        if race and best is not None and ci >= 1:
+            break  # a success after the newest policy: later rungs are slower
     if best is not None:
+        if canary_info is not None:
+            best.setdefault("canary_s", canary_info.get("canary_s"))
         print(json.dumps(best))
         return 0
-    if last_error_rec is not None and not race:
-        # Relay the inner run's full structured error line untouched.
+    if last_error_rec is not None:
+        # Relay the inner run's full structured error line untouched —
+        # race or not (ADVICE r2 low #3).
         print(json.dumps(last_error_rec))
         return 1
     print(json.dumps(error_result(args, last_err, attempts)))
@@ -403,4 +620,6 @@ def inner_main(args: argparse.Namespace) -> int:
 
 if __name__ == "__main__":
     _args = parse_args()
+    if _args._canary:
+        sys.exit(canary_main())
     sys.exit(inner_main(_args) if _args._inner else wrapper_main(_args))

@@ -376,6 +376,11 @@ class TrainConfig:
     # exactness). Single-process only: multi-host saves keep the internal
     # barrier on the main thread.
     checkpoint_async: bool = False
+    # Write a final checkpoint when the run ends off a checkpoint boundary
+    # (the reference's end-of-run save). False for throwaway runs —
+    # benchmarks, smoke tests — that must not leave resumable state behind
+    # or pay a synchronous full-state write inside a timed region.
+    save_final: bool = True
     log_interval: int = 10
     metrics_path: str = ""  # JSONL sink; "" = stdout only
     debug_nans: bool = False  # op-level NaN detection (slow; debugging only)

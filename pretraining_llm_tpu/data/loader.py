@@ -138,37 +138,118 @@ def synthetic_iterator(
     return BatchIterator(SyntheticTokens(vocab_size, context_length, seed), batch_size, seed)
 
 
-def device_prefetch(
-    iterator: Iterator[Tuple[np.ndarray, np.ndarray]],
-    put_fn: Any,
-    depth: int = 2,
-) -> Iterator[Any]:
-    """Run host sampling + H2D transfer ahead of the training step.
+class DevicePrefetcher:
+    """Run host sampling + H2D transfer ahead of the training step WITHOUT
+    giving up exact resume.
 
     `put_fn(host_batch) -> device_batch` (typically a sharded jax.device_put).
     A daemon thread keeps `depth` batches in flight — the TPU-native analog of
     the reference's pinned-memory `non_blocking=True` copy (data_loader.py:48),
     but overlapping the *sampling* too.
+
+    Exact-resume contract (VERDICT r2 next #8): each produced batch carries
+    the source iterator's RNG state snapshot taken immediately AFTER drawing
+    it; `state()` reports the snapshot of the last batch the CONSUMER took —
+    the consumed-batch frontier, exactly what the synchronous loop would
+    checkpoint. Batches still sitting in the queue at checkpoint/preemption
+    time are simply re-drawn (identically) on resume.
     """
-    q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
-    stop = threading.Event()
 
-    def worker() -> None:
+    _DONE = object()
+
+    def __init__(self, iterator: Iterator[Any], put_fn: Any, depth: int = 2) -> None:
+        self._it = iterator
+        self._put = put_fn
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._exhausted = False
+        has_state = hasattr(iterator, "state")
+        self._state = iterator.state() if has_state else None
+        self._thread = threading.Thread(
+            target=self._worker, args=(has_state,), daemon=True
+        )
+        self._thread.start()
+
+    def _offer(self, item: Any) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self, has_state: bool) -> None:
         try:
-            for batch in iterator:
-                if stop.is_set():
+            # Check stop BEFORE each draw (not only in _offer): after
+            # close(), the source iterator must not be advanced again — the
+            # owner may be about to rewind its RNG to the consumed frontier,
+            # and a post-rewind draw would corrupt it.
+            while not self._stop.is_set():
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    break
+                snap = self._it.state() if has_state else None
+                if not self._offer((self._put(batch), snap)):
                     return
-                q.put(put_fn(batch))
         except Exception as e:  # surface loader errors on the consumer side
-            q.put(e)
+            self._offer(e)
+        finally:
+            # ALWAYS terminate the stream — after a delivered exception too,
+            # so a consumer that catches it and calls next() again gets
+            # StopIteration instead of blocking forever on an empty queue.
+            self._offer(self._DONE)
 
-    thread = threading.Thread(target=worker, daemon=True)
-    thread.start()
-    try:
-        while True:
-            item = q.get()
-            if isinstance(item, Exception):
-                raise item
-            yield item
-    finally:
-        stop.set()
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._exhausted:
+            # Standard iterator contract: exhaustion is permanent and
+            # re-raisable — a second loop over the same object must get
+            # StopIteration again, not block on the empty queue.
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        batch, snap = item
+        if snap is not None:
+            self._state = snap
+        return batch
+
+    def state(self) -> Any:
+        """RNG frontier of the batches actually CONSUMED (not produced)."""
+        return self._state
+
+    def close(self) -> bool:
+        """Stop the worker and JOIN it. Returns True iff the worker is dead.
+
+        The join is load-bearing: callers rewind the source iterator's RNG
+        to the consumed frontier right after close(), which is only safe
+        once the worker can no longer draw from it (a mid-draw worker races
+        the rewind and silently corrupts the stream). A False return means
+        the worker is wedged (e.g. blocked in a slow device transfer) — the
+        caller must NOT rewind; keeping the live feed preserves determinism
+        through the queue instead.
+        """
+        self._stop.set()
+        # Unblock a worker stuck on a full queue.
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+        return not self._thread.is_alive()
+
+
+def device_prefetch(
+    iterator: Iterator[Tuple[np.ndarray, np.ndarray]],
+    put_fn: Any,
+    depth: int = 2,
+) -> Iterator[Any]:
+    """Iterator-style view of `DevicePrefetcher` (kept for API stability)."""
+    return DevicePrefetcher(iterator, put_fn, depth)

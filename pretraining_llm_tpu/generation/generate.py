@@ -155,7 +155,11 @@ def shard_params_for_inference(params: Any, mesh: Any) -> Any:
     `generate(..., mesh=mesh)` decodes models that exceed one chip's HBM."""
     from pretraining_llm_tpu.parallel.sharding import named_sharding_tree, param_pspec_tree
 
-    return jax.device_put(params, named_sharding_tree(mesh, param_pspec_tree(params)))
+    tensor_size = mesh.shape.get("tensor", 1)
+    return jax.device_put(
+        params,
+        named_sharding_tree(mesh, param_pspec_tree(params, tensor_size=tensor_size)),
+    )
 
 
 # ---------------------------------------------------------------------------

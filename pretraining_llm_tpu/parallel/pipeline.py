@@ -64,6 +64,47 @@ def bubble_fraction(n_micro: int, n_stages: int, interleave: int = 1) -> float:
     return (n_stages - 1) / schedule_ticks(n_micro, n_stages, interleave)
 
 
+def interleave_layout(blocks: Any, n_stages: int, interleave: int) -> Any:
+    """Permute stacked block params depth-major -> rank-major chunk order.
+
+    Depth chunk j = v*S + r lives on rank r under the interleaved schedule;
+    rank-major order (r, v, k) makes the contiguous P('pipe') shards hold
+    exactly each rank's V chunks. Baked ONCE into the train state
+    (train_step.shard_train_state) instead of per step, which removes the
+    cross-rank reshard + the XLA "[SPMD] involuntary full rematerialization"
+    warnings (VERDICT r2 next #5). Checkpoints stay canonical depth-major:
+    the trainer de-interleaves on save and re-interleaves on load.
+    """
+    if interleave <= 1:
+        return blocks
+
+    def perm(a):
+        lpc = a.shape[0] // (n_stages * interleave)
+        return (
+            a.reshape(interleave, n_stages, lpc, *a.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(a.shape)
+        )
+
+    return jax.tree.map(perm, blocks)
+
+
+def deinterleave_layout(blocks: Any, n_stages: int, interleave: int) -> Any:
+    """Inverse of `interleave_layout`: rank-major -> canonical depth-major."""
+    if interleave <= 1:
+        return blocks
+
+    def inv(a):
+        lpc = a.shape[0] // (n_stages * interleave)
+        return (
+            a.reshape(n_stages, interleave, lpc, *a.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(a.shape)
+        )
+
+    return jax.tree.map(inv, blocks)
+
+
 def pipeline_apply(
     blocks: Any,
     x: jax.Array,
@@ -73,6 +114,7 @@ def pipeline_apply(
     n_micro: int,
     remat: str = "none",
     interleave: int = 1,
+    baked: bool = False,
     pipe_axis: str = "pipe",
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
 ) -> Tuple[jax.Array, jax.Array]:
@@ -128,19 +170,14 @@ def pipeline_apply(
     n_layers = jax.tree.leaves(blocks)[0].shape[0]
     lpc = n_layers // (n_stages * interleave)  # layers per chunk
 
-    if interleave > 1:
-        # Chunk j = v*S + r (depth order) must live on rank r. Permute the
-        # stacked dim to rank-major (r, v, k) order so the contiguous
-        # P('pipe') shards hold exactly each rank's V chunks. This is an
-        # inherently cross-rank reshard of the layer stack (XLA may lower it
-        # as replicate-then-reshard) paid once per step — at production scale
-        # you'd bake the permuted layout into the train state instead.
-        blocks = jax.tree.map(
-            lambda a: a.reshape(interleave, n_stages, lpc, *a.shape[1:])
-            .swapaxes(0, 1)
-            .reshape(a.shape),
-            blocks,
-        )
+    if interleave > 1 and not baked:
+        # Chunk j = v*S + r (depth order) must live on rank r; the schedule
+        # needs rank-major (r, v, k) order. The TRAINING path bakes this
+        # layout into the state once (``baked=True``, no per-step cost); this
+        # in-line permute is the compatibility path for depth-major params
+        # (tests, ad-hoc loss_fn calls) — an inherently cross-rank reshard
+        # of the layer stack paid every step.
+        blocks = interleave_layout(blocks, n_stages, interleave)
 
     # The XLA CPU emitter check-fails ("Invalid binary instruction opcode
     # copy") on any bf16 all-reduce-family collective inside a partial-manual

@@ -33,7 +33,10 @@ GRID = {
 }
 
 
-def run_one(flags: dict, budget: float, preset: str, quick: bool = False) -> dict:
+def run_one(
+    flags: dict, budget: float, preset: str, quick: bool = False,
+    skip_canary: bool = False,
+) -> dict:
     cmd = [
         sys.executable, os.path.join(REPO, "bench.py"),
         "--preset", preset,
@@ -45,6 +48,11 @@ def run_one(flags: dict, budget: float, preset: str, quick: bool = False) -> dic
     ]
     if quick:
         cmd.append("--quick")
+    if skip_canary:
+        # The environment was proven alive by the first config's canary;
+        # later configs skip it (a mid-sweep tunnel death still surfaces as
+        # that config's structured bench error).
+        cmd.append("--skip-canary")
     t0 = time.time()
     rec = {"flags": flags}
     try:
@@ -79,9 +87,14 @@ def main() -> None:
     ]
     results = []
     with open(args.out, "a") as f:
+        env_alive = False
         for i, flags in enumerate(combos):
             print(f"[{i + 1}/{len(combos)}] {flags}", flush=True)
-            rec = run_one(flags, budget, args.preset, quick=args.quick)
+            rec = run_one(
+                flags, budget, args.preset, quick=args.quick, skip_canary=env_alive
+            )
+            if rec.get("value", 0) > 0 or not rec.get("environment_error"):
+                env_alive = True
             f.write(json.dumps(rec) + "\n")
             f.flush()
             results.append(rec)

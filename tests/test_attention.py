@@ -119,6 +119,86 @@ def test_shard_mapped_flash_kernel_matches_dense(mesh8):
     assert shard_mapped_kernel(kernel, q3, k[:, :, :3], v[:, :, :3], mesh8) is None
 
 
+def test_flash_dispatch_manual_region_classification(monkeypatch):
+    """Dispatch must distinguish FULLY-manual from PARTIAL-manual regions.
+
+    Inside a partial-manual region (the pipeline: manual over 'pipe' only)
+    activations are still auto-sharded over data/fsdp, so a direct
+    pallas_call would be replicated by GSPMD (all-gathering the global
+    batch) — the dispatcher must use the blockwise fallback there, and only
+    call the kernel directly when every nontrivial mesh axis is manual
+    (ADVICE r2 low #2).
+    """
+    import pretraining_llm_tpu.ops.flash_attention as fa
+    import pretraining_llm_tpu.ops.pallas_flash as pf
+    from jax.sharding import Mesh, PartitionSpec as P
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    calls = []
+
+    def fake_kernel(q, k, v, *, causal=True, block_q=0, block_kv=0, **kw):
+        calls.append(q.shape)
+        return blockwise_attention(q, k, v, causal=causal)
+
+    monkeypatch.setattr(fa, "_pallas_available", lambda: True)
+    monkeypatch.setattr(pf, "pallas_flash_attention", fake_kernel)
+
+    from tests.conftest import AXES
+
+    devs = np.asarray(jax.devices()).reshape(2, 1, 1, 1, 1, 4)
+    mesh = Mesh(devs, AXES)  # 2 data x 4 pipe
+    ks = jax.random.split(jax.random.key(13), 3)
+    q, k, v = (jax.random.normal(kk, (4, 32, 4, 8), jnp.float32) for kk in ks)
+    want = naive_attention(q, k, v, causal=True)
+
+    def body(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True)
+
+    # Partial-manual ('pipe' only, data stays auto): kernel must NOT be
+    # called directly — blockwise fallback handles the auto axes via GSPMD.
+    with activation_mesh(mesh):
+        got = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+                axis_names={"pipe"}, check_vma=False,
+            )
+        )(q, k, v)
+    assert calls == [], "direct kernel call inside a partial-manual region"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    # Fully-manual (every nontrivial axis manual): operands are per-device
+    # local arrays — the direct kernel call is the correct path.
+    with activation_mesh(mesh):
+        got2 = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data")), out_specs=P("data"),
+                axis_names={"data", "pipe"}, check_vma=False,
+            )
+        )(q, k, v)
+    assert len(calls) == 1, "fully-manual region must take the direct kernel path"
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_blockwise_fallback_warns(monkeypatch, mesh_seq4):
+    """VERDICT r2 #9: when the Pallas dispatch can't express the layout
+    per-shard it must WARN that the blockwise JAX path took over."""
+    import pretraining_llm_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_pallas_available", lambda: True)
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    ks = jax.random.split(jax.random.key(14), 3)
+    q, k, v = (jax.random.normal(kk, (4, 32, 4, 8), jnp.float32) for kk in ks)
+    with activation_mesh(mesh_seq4):  # seq-sharded: not expressible per-shard
+        with pytest.warns(UserWarning, match="falling back to blockwise"):
+            got = fa.flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(naive_attention(q, k, v, causal=True)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_shard_mapped_kernel_rejects_indivisible_batch(mesh8):
     """Batch not divisible by the data x fsdp shards -> None (fallback),
     never a shard_map trace error."""

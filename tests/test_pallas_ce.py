@@ -125,7 +125,33 @@ def test_model_fused_falls_back_for_biased_head():
     )
     params = transformer.init_params(cfg, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
-    loss = transformer.loss_fn(params, tokens, jnp.roll(tokens, -1, 1), cfg)
+    with pytest.warns(UserWarning, match="fused.*degraded to chunked"):
+        loss = transformer.loss_fn(params, tokens, jnp.roll(tokens, -1, 1), cfg)
+    assert np.isfinite(float(loss))
+
+
+def test_model_fused_degrade_warns_on_tensor_sharded_mesh():
+    """VERDICT r2 #9: enabling the fused head on a TP mesh must SAY it
+    degraded to chunked instead of silently training slower."""
+    from jax.sharding import Mesh
+
+    from pretraining_llm_tpu.config import ModelConfig
+    from pretraining_llm_tpu.models import transformer
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    cfg = ModelConfig(
+        vocab_size=96, context_length=16, d_model=32, n_heads=4, n_layers=1,
+        ce_impl="fused", param_dtype="float32", compute_dtype="float32",
+    )
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    devs = np.asarray(jax.devices()).reshape(2, 1, 4, 1, 1, 1)
+    mesh = Mesh(devs, ("data", "fsdp", "tensor", "seq", "expert", "pipe"))
+    with activation_mesh(mesh):
+        with pytest.warns(UserWarning, match="fused.*degraded to chunked"):
+            loss = jax.jit(
+                lambda p: transformer.loss_fn(p, tokens, jnp.roll(tokens, -1, 1), cfg)
+            )(params)
     assert np.isfinite(float(loss))
 
 

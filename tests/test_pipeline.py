@@ -335,3 +335,65 @@ def test_pipeline_composes_with_fsdp_and_ep(axis):
     np.testing.assert_allclose(
         float(metrics["loss"]), float(metrics1["loss"]), rtol=1e-4
     )
+
+
+def test_baked_layout_roundtrip_and_step_equivalence(mesh_pipe4):
+    """VERDICT r2 #5: the interleaved layout is baked into the train state
+    (no per-step cross-rank reshard). bake -> unbake is the identity, the
+    baked sharded step matches the single-device depth-major step, and the
+    step-1 params de-interleave back to the single-device step-1 params."""
+    from pretraining_llm_tpu.parallel import pipeline as pp
+
+    tiny = get_preset("tiny")
+    cfg = tiny.replace(
+        model=dataclasses.replace(
+            tiny.model,
+            n_layers=8,
+            pipeline_stages=4,
+            pipeline_microbatches=4,
+            pipeline_interleave=2,
+            param_dtype="float32",
+            compute_dtype="float32",
+        ),
+        mesh=dataclasses.replace(tiny.mesh, data=2, pipe=4),
+        train=dataclasses.replace(tiny.train, batch_size=8, microbatches=1),
+    )
+    state = ts.init_train_state(cfg, jax.random.key(0))
+
+    # Round trip is the identity.
+    baked = ts.bake_state_layout(state, cfg, forward=True)
+    unbaked = ts.bake_state_layout(baked, cfg, forward=False)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state, unbaked,
+    )
+    # And it really permutes (layer 1 moved off slot 1).
+    w = np.asarray(state["params"]["blocks"]["attn"]["wqkv"])
+    wb = np.asarray(baked["params"]["blocks"]["attn"]["wqkv"])
+    assert not np.array_equal(w[1], wb[1])
+    # Rank-major order: rank r holds chunks (r, S+r) -> slot 1 is depth chunk 4.
+    np.testing.assert_array_equal(wb[1], w[4])
+
+    assert ts.uses_baked_layout(cfg, mesh_pipe4)
+    x = jax.random.randint(jax.random.key(1), (8, cfg.model.context_length), 0,
+                           cfg.model.vocab_size)
+    y = jnp.roll(x, -1, axis=1)
+
+    sharded = ts.shard_train_state(jax.tree.map(jnp.copy, state), mesh_pipe4, cfg)
+    step = ts.build_train_step(cfg, mesh_pipe4)
+    sharded, metrics = step(sharded, (x, y))
+
+    single = ts.build_train_step(cfg, mesh=None)
+    state1, metrics1 = single(state, (x, y))
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(metrics1["loss"]), rtol=1e-4
+    )
+    # Step-1 params, de-interleaved, match the single-device step-1 params.
+    got = ts.bake_state_layout(jax.device_get(sharded), cfg, forward=False)
+    flat_got = dict(jax.tree_util.tree_flatten_with_path(got["params"])[0])
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state1["params"])[0]:
+        np.testing.assert_allclose(
+            np.asarray(flat_got[tuple(path)]), np.asarray(leaf),
+            rtol=2e-3, atol=1e-5,
+            err_msg=f"param mismatch at {jax.tree_util.keystr(path)}",
+        )
