@@ -57,26 +57,48 @@ def _generate_jit(
     top_k: Optional[int],
     top_p: Optional[float],
     mesh: Any = None,
+    prompt_lengths: Optional[jax.Array] = None,  # (B,) int32 — ragged rows
 ) -> jax.Array:
     from pretraining_llm_tpu.parallel.sharding import activation_mesh
 
     b = prompt.shape[0]
-    total = prompt.shape[1] + max_new_tokens
+    bucket = prompt.shape[1]
+    total = bucket + max_new_tokens
     with activation_mesh(mesh):
         cache = transformer.make_kv_cache(cfg, b, total)
 
-        # Prefill: one forward over the whole padded prompt. Causality keeps
-        # pad positions (>= prompt_len) invisible to real ones, and each pad
-        # slot's garbage K/V is overwritten by the decoded token that lands
-        # there before the kv_mask ever exposes it.
-        logits, cache = transformer.forward(
-            params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
-        )
         key, sub = jax.random.split(key)
-        idx = jnp.broadcast_to(
-            (prompt_len - 1).astype(jnp.int32), (b, 1, logits.shape[-1])
-        )
-        last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+        if prompt_lengths is None:
+            pad_off = None
+            # Prefill: one forward over the whole padded prompt. Causality
+            # keeps pad positions (>= prompt_len) invisible to real ones,
+            # and each pad slot's garbage K/V is overwritten by the decoded
+            # token that lands there before the kv_mask ever exposes it.
+            logits, cache = transformer.forward(
+                params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
+            )
+            idx = jnp.broadcast_to(
+                (prompt_len - 1).astype(jnp.int32), (b, 1, logits.shape[-1])
+            )
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            start_index = prompt_len.astype(jnp.int32)
+        else:
+            # RAGGED rows: shift each row right so every prompt ENDS at slot
+            # bucket-1 (left-padding). All rows then decode in lockstep at
+            # shared slot indices; the per-row pad_offsets drive logical
+            # positions + the kv mask inside forward. Slots [0, offset_i)
+            # stay dead for the whole generation.
+            pad_off = (bucket - prompt_lengths).astype(jnp.int32)
+            slots = jnp.arange(bucket)[None, :]
+            src = slots - pad_off[:, None]
+            left = jnp.take_along_axis(prompt, jnp.clip(src, 0, bucket - 1), axis=1)
+            left = jnp.where(src >= 0, left, 0)
+            logits, cache = transformer.forward(
+                params, left, cfg, kv_cache=cache, cache_index=jnp.int32(0),
+                pad_offsets=pad_off,
+            )
+            last = logits[:, -1]  # slot bucket-1 = every row's final token
+            start_index = jnp.int32(bucket)
         next_tok = sample_logits(
             last, sub, temperature=temperature, top_k=top_k, top_p=top_p
         )
@@ -84,7 +106,8 @@ def _generate_jit(
         def decode_step(carry, _):
             cache, tok, key, index = carry
             logits, cache = transformer.forward(
-                params, tok[:, None], cfg, kv_cache=cache, cache_index=index
+                params, tok[:, None], cfg, kv_cache=cache, cache_index=index,
+                pad_offsets=pad_off,
             )
             key, sub = jax.random.split(key)
             nxt = sample_logits(
@@ -94,7 +117,7 @@ def _generate_jit(
 
         (_, _, _, _), toks = jax.lax.scan(
             decode_step,
-            (cache, next_tok, key, prompt_len.astype(jnp.int32)),
+            (cache, next_tok, key, start_index),
             None,
             length=max_new_tokens,
         )
@@ -114,8 +137,18 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     mesh: Any = None,
+    prompt_lengths: Optional[Any] = None,
 ) -> jax.Array:
     """Generate continuations. prompt_tokens: (B, P) or (P,) int32.
+
+    ``prompt_lengths`` ((B,) int32) enables RAGGED batches: rows of
+    different true lengths, right-padded to P on input. Internally each row
+    is left-shifted so every prompt ends at the same slot and the whole
+    batch decodes in lockstep — one compiled program, no per-row loops;
+    row i's continuation starts right after its own last prompt token
+    (serving-grade batched decode; the reference generates batch-1 only,
+    generate_text.py:41-42). Not supported for MoE models (pad slots would
+    compete for expert capacity during prefill).
 
     Returns (B, max_new_tokens) of sampled ids. The whole prompt+generation
     must fit the model context (the KV cache is position-table bound).
@@ -134,6 +167,24 @@ def generate(
             f"prompt({prompt_len}) + max_new_tokens({max_new_tokens}) exceeds "
             f"context_length={cfg.context_length}"
         )
+    if prompt_lengths is not None:
+        if cfg.n_experts:
+            raise ValueError(
+                "ragged prompt_lengths is unsupported for MoE models: left-"
+                "pad slots would compete for expert capacity during prefill"
+            )
+        lengths = jnp.asarray(prompt_lengths, jnp.int32).reshape(-1)
+        if lengths.shape[0] != prompt.shape[0]:
+            raise ValueError(
+                f"prompt_lengths has {lengths.shape[0]} rows for a batch of "
+                f"{prompt.shape[0]}"
+            )
+        if int(jnp.max(lengths)) > prompt_len or int(jnp.min(lengths)) < 1:
+            raise ValueError(
+                "prompt_lengths must lie in [1, P] for (B, P) prompt_tokens"
+            )
+    else:
+        lengths = None
     # MoE prefill routes with a capacity proportional to the token count and
     # pad tokens would compete for expert slots, perturbing real tokens'
     # hidden states — bucketing is for dense models only.
@@ -142,11 +193,15 @@ def generate(
         if cfg.n_experts
         else _bucket_len(prompt_len, cfg.context_length, max_new_tokens)
     )
+    # Ragged rows occupy slots up to bucket+max_new (dead left-pads
+    # included): always within the context, since the earlier prompt_len
+    # check plus _bucket_len's cap give bucket <= ctx - max_new_tokens.
+    assert bucket + max_new_tokens <= cfg.context_length
     if bucket > prompt_len:
         prompt = jnp.pad(prompt, ((0, 0), (0, bucket - prompt_len)))
     return _generate_jit(
         params, prompt, jnp.int32(prompt_len), key, cfg, max_new_tokens,
-        temperature, top_k, top_p, mesh,
+        temperature, top_k, top_p, mesh, lengths,
     )
 
 
@@ -220,3 +275,56 @@ def generate_text(
         top_p=top_p,
     )
     return input_text + enc.decode(np.asarray(out[0]).tolist())
+
+
+def generate_text_batch(
+    model_path: str,
+    input_texts: list,
+    max_new_tokens: int = 100,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    seed: int = 0,
+    tokenizer: Optional[str] = None,
+) -> list:
+    """Batched continuation of DIFFERENT-length prompts in one compiled
+    ragged decode (`generate(..., prompt_lengths=...)`) — one device
+    program for the whole batch instead of a per-prompt loop. Returns one
+    continuation string per input."""
+    from pretraining_llm_tpu.data.tokenizer import get_tokenizer
+
+    if not input_texts:
+        raise ValueError("input_texts is empty (nothing to generate)")
+    params, cfg = load_model_for_inference(model_path)
+    enc = get_tokenizer(tokenizer or cfg.data.tokenizer_name)
+    encoded = [
+        np.asarray(enc.encode_ordinary(t), np.int32) for t in input_texts
+    ]
+    empty = [i for i, e in enumerate(encoded) if len(e) == 0]
+    if empty:
+        raise ValueError(
+            f"prompts at indices {empty} encode to zero tokens; ragged "
+            "decode needs at least one real token per row"
+        )
+    lengths = np.asarray([len(e) for e in encoded], np.int32)
+    pmax = int(lengths.max())
+    batch = np.zeros((len(encoded), pmax), np.int32)
+    for i, e in enumerate(encoded):
+        batch[i, : len(e)] = e
+    out = np.asarray(
+        generate(
+            params,
+            cfg.model,
+            batch,
+            max_new_tokens,
+            jax.random.key(seed),
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            prompt_lengths=lengths,
+        )
+    )
+    return [
+        t + enc.decode(out[i].tolist()) for i, t in enumerate(input_texts)
+    ]

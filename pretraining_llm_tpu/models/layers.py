@@ -76,9 +76,18 @@ def rope_table(context_length: int, head_dim: int, theta: float) -> Tuple[jax.Ar
 def apply_rope(
     x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array
 ) -> jax.Array:
-    """Rotate (B, T, H, Dh) by position. positions: (T,) int32 into the table."""
-    cos_t = cos[positions][None, :, None, :]  # (1, T, 1, Dh/2)
-    sin_t = sin[positions][None, :, None, :]
+    """Rotate (B, T, H, Dh) by position.
+
+    positions: (T,) int32 into the table — shared across the batch — or
+    (B, T) for per-row positions (ragged left-padded decode, where row i's
+    token at slot s has logical position s - pad_offset_i).
+    """
+    if positions.ndim == 2:
+        cos_t = cos[positions][:, :, None, :]  # (B, T, 1, Dh/2)
+        sin_t = sin[positions][:, :, None, :]
+    else:
+        cos_t = cos[positions][None, :, None, :]  # (1, T, 1, Dh/2)
+        sin_t = sin[positions][None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos_t - x2 * sin_t, x2 * cos_t + x1 * sin_t], axis=-1)
     return rotated.astype(x.dtype)

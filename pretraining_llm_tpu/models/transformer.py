@@ -149,8 +149,17 @@ def _attention_block(
     kv: Optional[Tuple[jax.Array, jax.Array]],
     cache_index: Optional[jax.Array],
     zigzag: bool = False,
+    pad_offsets: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
-    """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv)."""
+    """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv).
+
+    ``pad_offsets`` (B,) enables RAGGED cached decode: row i is left-padded
+    by pad_offsets[i] slots, so its token at cache slot s has logical
+    position s - pad_offsets[i]. Slot indices drive causality (equivalent
+    to logical causality under a shared left-pad layout), RoPE uses the
+    per-row logical positions, and the kv mask excludes each row's dead
+    pad slots.
+    """
     cdt = jnp.dtype(cfg.compute_dtype)
     h = layers.apply_norm(cfg.norm, blk["ln1"], x, cfg.norm_eps)
     if "wqkv" in blk["attn"]:
@@ -178,8 +187,14 @@ def _attention_block(
 
     if rope is not None:
         cos, sin = rope
-        q = layers.apply_rope(q, cos, sin, positions)
-        k = layers.apply_rope(k, cos, sin, positions)
+        if pad_offsets is not None:
+            # Per-row logical positions: slot - left-pad offset. Pad slots
+            # clip to 0; their K/V is masked out of every real attention.
+            rope_pos = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
+        else:
+            rope_pos = positions
+        q = layers.apply_rope(q, cos, sin, rope_pos)
+        k = layers.apply_rope(k, cos, sin, rope_pos)
 
     # Remat tags for the 'save_qkv_attn'/'save_big' policies: with post-RoPE
     # q/k/v saved, the attention backward starts directly from its VJP inputs
@@ -225,6 +240,7 @@ def _attention_block(
         if (
             tq > 1
             and prefill_at_zero
+            and pad_offsets is None  # ragged rows need the per-row kv mask
             and cfg.attention_impl in ("flash", "ring", "ulysses")
         ):
             # PREFILL (kv_cache set, Tq>1, cache_index==0): attending over
@@ -244,6 +260,10 @@ def _attention_block(
         else:
             kv_positions = jnp.arange(tmax)
             kv_mask = (kv_positions < cache_index + tq)[None, :]
+            if pad_offsets is not None:
+                # Ragged rows: slots below each row's left-pad offset are
+                # dead (never written with real tokens) — mask them out.
+                kv_mask = kv_mask & (kv_positions[None, :] >= pad_offsets[:, None])
             out = multihead_attention(
                 q,
                 cache_k.astype(cdt),
@@ -335,8 +355,11 @@ def _block(
     kv: Optional[Tuple[jax.Array, jax.Array]],
     cache_index: Optional[jax.Array],
     zigzag: bool = False,
+    pad_offsets: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]], jax.Array]:
-    x, new_kv = _attention_block(blk, x, cfg, rope, positions, kv, cache_index, zigzag)
+    x, new_kv = _attention_block(
+        blk, x, cfg, rope, positions, kv, cache_index, zigzag, pad_offsets
+    )
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
     )
@@ -368,6 +391,7 @@ def forward(
     return_pre_logits: bool = False,
     zigzag: bool = False,
     blocks_baked: bool = False,
+    pad_offsets: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
 
@@ -399,9 +423,22 @@ def forward(
     .interleave_layout, baked by train_step.shard_train_state) — only valid
     when the pipelined path is active, and required for correctness with a
     baked state.
+
+    ``pad_offsets`` (B,) int32 enables RAGGED cached decode (decode-only;
+    requires ``kv_cache``): each row is left-padded by pad_offsets[i] dead
+    slots, so a batch of different-length prompts decodes in lockstep —
+    `generation.generate(..., prompt_lengths=...)` builds this layout. Row
+    i's token at cache slot s has logical position s - pad_offsets[i]
+    (RoPE / learned positions use logical; causality + cache writes use
+    slots; the kv mask hides each row's pad slots).
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t = tokens.shape
+    if pad_offsets is not None and kv_cache is None:
+        raise ValueError(
+            "pad_offsets (ragged left-padded rows) is a cached-decode "
+            "layout; training/eval calls must not pass it"
+        )
     if positions is None:
         start = cache_index if cache_index is not None else 0
         positions = start + jnp.arange(t)
@@ -417,7 +454,11 @@ def forward(
     x = emb_table[tokens].astype(cdt)
     if cfg.pos_embed == "learned":
         pos_table = constrain(params["pos_embed"]["embedding"], None, None)
-        x = x + pos_table[positions].astype(cdt)[None]
+        if pad_offsets is not None:
+            logical = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
+            x = x + pos_table[logical].astype(cdt)  # (B, T, D) per-row gather
+        else:
+            x = x + pos_table[positions].astype(cdt)[None]
         rope = None
     else:
         rope = layers.rope_table(cfg.context_length, cfg.head_dim, cfg.rope_theta)
@@ -430,7 +471,10 @@ def forward(
             x, _, aux = _block(blk, x, cfg, rope, positions, None, None, zigzag)
             return (x, aux_sum + aux), (x if return_hidden else None)
         blk, ck, cv = layer_inputs
-        x, new_kv, aux = _block(blk, x, cfg, rope, positions, (ck, cv), cache_index)
+        x, new_kv, aux = _block(
+            blk, x, cfg, rope, positions, (ck, cv), cache_index,
+            pad_offsets=pad_offsets,
+        )
         return (x, aux_sum + aux), new_kv
 
     body = remat.checkpoint_wrap(scan_body, cfg.remat)

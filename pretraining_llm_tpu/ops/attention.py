@@ -60,6 +60,13 @@ def naive_attention(
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
+    if kv_mask is not None:
+        # A query slot whose EVERY key is masked (a dead left-pad slot in
+        # ragged decode) softmaxes to NaN (0/0). Zero it: its output then
+        # stays finite garbage, so downstream layers' 0-weight attention to
+        # it contributes exactly 0 instead of 0*NaN = NaN poisoning every
+        # real slot in the batch row.
+        probs = jnp.where(jnp.isfinite(probs), probs, 0.0)
     out = jnp.einsum(
         "bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
     )

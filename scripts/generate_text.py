@@ -26,7 +26,13 @@ from pretraining_llm_tpu.generation.generate import generate_text
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model_path", required=True, help="checkpoint dir (or a step-N dir)")
-    parser.add_argument("--input_text", required=True)
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--input_text")
+    group.add_argument(
+        "--input_file",
+        help="file with one prompt per line: the whole batch decodes in ONE "
+        "compiled ragged program (different prompt lengths supported)",
+    )
     parser.add_argument("--max_new_tokens", type=int, default=100)
     parser.add_argument("--temperature", type=float, default=1.0, help="0 = greedy")
     parser.add_argument("--top_k", type=int, default=None)
@@ -37,6 +43,26 @@ def main() -> None:
         help="override the tokenizer name stored in the checkpoint config",
     )
     args = parser.parse_args()
+
+    if args.input_file:
+        from pretraining_llm_tpu.generation.generate import generate_text_batch
+
+        with open(args.input_file) as f:
+            prompts = [line.rstrip("\n") for line in f if line.strip()]
+        outs = generate_text_batch(
+            args.model_path,
+            prompts,
+            args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            seed=args.seed,
+            tokenizer=args.tokenizer,
+        )
+        for text in outs:
+            print(text)
+            print("---")
+        return
 
     text = generate_text(
         args.model_path,
