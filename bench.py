@@ -69,6 +69,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--prefetch", type=int, default=-1,
         help="trainer mode: data.prefetch depth override (-1 = preset value)",
     )
+    parser.add_argument(
+        "--ragged", action="store_true",
+        help="decode mode: serving-shaped batch with per-row prompt lengths "
+        "(one lockstep ragged program)",
+    )
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
     parser.add_argument("--ce", default="", choices=["", "chunked", "fused"])
     parser.add_argument(
@@ -169,10 +174,21 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )
+    # --ragged: serving-shaped batch — per-row prompt lengths spread over
+    # [prompt_len/4, prompt_len], decoded in the one lockstep ragged program.
+    lengths = None
+    if args.ragged:
+        import numpy as _np
+
+        rng = _np.random.default_rng(0)
+        lengths = rng.integers(
+            max(prompt_len // 4, 1), prompt_len + 1, size=batch
+        ).astype(_np.int32)
 
     def run(seed):
         out = generate(
-            params, cfg, prompt, new_tokens, jax.random.key(seed), temperature=1.0
+            params, cfg, prompt, new_tokens, jax.random.key(seed),
+            temperature=1.0, prompt_lengths=lengths,
         )
         # device_get, not block_until_ready: the latter does not actually
         # synchronize on the tunneled-TPU backend (same protocol as the
@@ -186,7 +202,7 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         run(s)
     dt = (time.perf_counter() - t0) / n_runs
     tps = batch * new_tokens / dt
-    return {
+    rec = {
         "metric": f"decode_tokens_per_sec_{args.preset}",
         "value": round(tps, 1),
         "unit": "tokens_per_sec",
@@ -198,6 +214,10 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "attention": "naive (cached-decode path)",
         "device": jax.devices()[0].device_kind,
     }
+    if lengths is not None:
+        rec["metric"] += "_ragged"
+        rec["prompt_lengths"] = [int(x) for x in lengths]
+    return rec
 
 
 def run_trainer_bench(args: argparse.Namespace) -> dict:
@@ -467,6 +487,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--mode", args.mode]
     if args.prefetch >= 0:
         cmd += ["--prefetch", str(args.prefetch)]
+    if args.ragged:
+        cmd.append("--ragged")
     if args.attention or attention:
         cmd += ["--attention", args.attention or attention]
     if args.ce:

@@ -58,6 +58,7 @@ def _generate_jit(
     top_p: Optional[float],
     mesh: Any = None,
     prompt_lengths: Optional[jax.Array] = None,  # (B,) int32 — ragged rows
+    stop_token: Optional[jax.Array] = None,  # () int32 — traced, no recompile per id
 ) -> jax.Array:
     from pretraining_llm_tpu.parallel.sharding import activation_mesh
 
@@ -83,21 +84,33 @@ def _generate_jit(
             last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
             start_index = prompt_len.astype(jnp.int32)
         else:
-            # RAGGED rows: shift each row right so every prompt ENDS at slot
-            # bucket-1 (left-padding). All rows then decode in lockstep at
-            # shared slot indices; the per-row pad_offsets drive logical
-            # positions + the kv mask inside forward. Slots [0, offset_i)
-            # stay dead for the whole generation.
+            # RAGGED rows. Prefill runs RIGHT-padded — plain causal
+            # attention, so real tokens never see the trailing pads, RoPE/
+            # learned positions are already logical, and the FLASH prefill
+            # shortcut applies (no (Tq, Tmax) scores at long prompts). The
+            # written cache is then rolled right per row so every prompt
+            # ends at slot bucket-1: the batch decodes in lockstep at
+            # shared slot indices, with per-row pad_offsets driving logical
+            # positions + the kv mask. Slots [0, offset_i) hold garbage
+            # copies that the decode kv mask never exposes.
             pad_off = (bucket - prompt_lengths).astype(jnp.int32)
-            slots = jnp.arange(bucket)[None, :]
-            src = slots - pad_off[:, None]
-            left = jnp.take_along_axis(prompt, jnp.clip(src, 0, bucket - 1), axis=1)
-            left = jnp.where(src >= 0, left, 0)
             logits, cache = transformer.forward(
-                params, left, cfg, kv_cache=cache, cache_index=jnp.int32(0),
-                pad_offsets=pad_off,
+                params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
             )
-            last = logits[:, -1]  # slot bucket-1 = every row's final token
+            idx = jnp.broadcast_to(
+                (prompt_lengths - 1).astype(jnp.int32)[:, None, None],
+                (b, 1, logits.shape[-1]),
+            )
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            src = jnp.clip(
+                jnp.arange(total)[None, :] - pad_off[:, None], 0, total - 1
+            )  # (B, total)
+            cache = jax.tree.map(
+                lambda c: jnp.take_along_axis(
+                    c, src[None, :, :, None, None], axis=2
+                ),
+                cache,
+            )
             start_index = jnp.int32(bucket)
         next_tok = sample_logits(
             last, sub, temperature=temperature, top_k=top_k, top_p=top_p
@@ -113,6 +126,11 @@ def _generate_jit(
             nxt = sample_logits(
                 logits[:, 0], sub, temperature=temperature, top_k=top_k, top_p=top_p
             )
+            if stop_token is not None:
+                # A finished row keeps emitting its stop token: the scan
+                # stays fixed-length (XLA-friendly), the caller truncates.
+                done = tok == stop_token
+                nxt = jnp.where(done, stop_token.astype(jnp.int32), nxt)
             return (cache, nxt, key, index + 1), tok
 
         (_, _, _, _), toks = jax.lax.scan(
@@ -138,8 +156,14 @@ def generate(
     top_p: Optional[float] = None,
     mesh: Any = None,
     prompt_lengths: Optional[Any] = None,
+    stop_token: Optional[int] = None,
 ) -> jax.Array:
     """Generate continuations. prompt_tokens: (B, P) or (P,) int32.
+
+    ``stop_token``: once a row samples it, the row keeps emitting it for
+    the remaining steps (fixed-length device program; strip the trailing
+    stop tokens host-side). The reference has no stop handling at all
+    (generate loops a fixed count, transformer.py:96-114).
 
     ``prompt_lengths`` ((B,) int32) enables RAGGED batches: rows of
     different true lengths, right-padded to P on input. Internally each row
@@ -199,9 +223,10 @@ def generate(
     assert bucket + max_new_tokens <= cfg.context_length
     if bucket > prompt_len:
         prompt = jnp.pad(prompt, ((0, 0), (0, bucket - prompt_len)))
+    stop = jnp.int32(stop_token) if stop_token is not None else None
     return _generate_jit(
         params, prompt, jnp.int32(prompt_len), key, cfg, max_new_tokens,
-        temperature, top_k, top_p, mesh, lengths,
+        temperature, top_k, top_p, mesh, lengths, stop,
     )
 
 
@@ -259,22 +284,16 @@ def generate_text(
 
     `tokenizer` overrides the name stored in the checkpoint's config (e.g. a
     checkpoint trained elsewhere whose BPE files aren't available here)."""
-    from pretraining_llm_tpu.data.tokenizer import get_tokenizer
-
-    params, cfg = load_model_for_inference(model_path)
-    enc = get_tokenizer(tokenizer or cfg.data.tokenizer_name)
-    ids = np.asarray(enc.encode_ordinary(input_text), np.int32)[None, :]
-    out = generate(
-        params,
-        cfg.model,
-        ids,
+    return generate_text_batch(
+        model_path,
+        [input_text],
         max_new_tokens,
-        jax.random.key(seed),
         temperature=temperature,
         top_k=top_k,
         top_p=top_p,
-    )
-    return input_text + enc.decode(np.asarray(out[0]).tolist())
+        seed=seed,
+        tokenizer=tokenizer,
+    )[0]
 
 
 def generate_text_batch(

@@ -62,11 +62,17 @@ def naive_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     if kv_mask is not None:
         # A query slot whose EVERY key is masked (a dead left-pad slot in
-        # ragged decode) softmaxes to NaN (0/0). Zero it: its output then
-        # stays finite garbage, so downstream layers' 0-weight attention to
-        # it contributes exactly 0 instead of 0*NaN = NaN poisoning every
-        # real slot in the batch row.
-        probs = jnp.where(jnp.isfinite(probs), probs, 0.0)
+        # ragged decode) softmaxes to NaN (0/0). Zero exactly those rows —
+        # derived from the MASKS, not from isfinite(), so genuine NaNs from
+        # corrupt weights still propagate loudly. Without this, downstream
+        # layers' 0-weight attention to the dead slot contributes 0*NaN =
+        # NaN, poisoning every real slot in the batch row.
+        if causal:
+            valid = causal_mask[None, :, :] & kv_mask[:, None, :]  # (B,Tq,Tk)
+        else:
+            valid = jnp.broadcast_to(kv_mask[:, None, :], (b, tq, tk))
+        dead = ~valid.any(axis=-1)  # (B, Tq)
+        probs = jnp.where(dead[:, None, None, :, None], 0.0, probs)
     out = jnp.einsum(
         "bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
     )

@@ -48,7 +48,7 @@ def main() -> None:
         from pretraining_llm_tpu.generation.generate import generate_text_batch
 
         with open(args.input_file) as f:
-            prompts = [line.rstrip("\n") for line in f if line.strip()]
+            prompts = [line.rstrip("\r\n") for line in f if line.strip()]
         outs = generate_text_batch(
             args.model_path,
             prompts,

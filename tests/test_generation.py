@@ -266,15 +266,19 @@ def test_generate_flash_equals_naive_greedy(params):
     np.testing.assert_array_equal(got_n, got_f)
 
 
-@pytest.mark.parametrize("pos", ["learned", "rope"])
-def test_ragged_batched_generation_matches_per_row(params, pos):
+@pytest.mark.parametrize(
+    "pos,impl",
+    [("learned", "naive"), ("rope", "naive"), ("rope", "flash")],
+)
+def test_ragged_batched_generation_matches_per_row(params, pos, impl):
     """Serving-grade ragged batches: rows with different prompt lengths
-    decode in ONE lockstep program (internal left-padding) and each row's
-    greedy continuation must equal generating that row alone."""
-    cfg = dataclasses.replace(CFG, pos_embed=pos)
+    decode in ONE lockstep program (right-padded flash-capable prefill,
+    per-row cache roll, left-pad lockstep decode) and each row's greedy
+    continuation must equal generating that row alone."""
+    cfg = dataclasses.replace(CFG, pos_embed=pos, attention_impl=impl)
     p = (
         params
-        if pos == "learned"
+        if (pos, impl) == ("learned", "naive")
         else transformer.init_params(cfg, jax.random.key(0))
     )
     lengths = [3, 8, 5]
@@ -352,3 +356,27 @@ def test_generate_text_batch_ragged_cli(tmp_path):
             str(tmp_path / "ck"), prompt, max_new_tokens=5, temperature=0.0
         )
         assert out == single, (out, single)
+
+
+def test_stop_token_freezes_finished_rows(params):
+    """Once a row samples the stop token it emits only the stop token for
+    the remaining steps; tokens before the stop match the un-stopped run."""
+    prompt = jax.random.randint(jax.random.key(30), (2, 6), 0, CFG.vocab_size)
+    base = np.asarray(
+        generate(params, CFG, prompt, 10, jax.random.key(3), temperature=0.0)
+    )
+    stop = int(base[0, 2])  # a token the greedy run actually emits
+    got = np.asarray(
+        generate(
+            params, CFG, prompt, 10, jax.random.key(3), temperature=0.0,
+            stop_token=stop,
+        )
+    )
+    for row in range(2):
+        hits = np.where(base[row] == stop)[0]
+        if hits.size == 0:
+            np.testing.assert_array_equal(got[row], base[row])
+            continue
+        first = int(hits[0])
+        np.testing.assert_array_equal(got[row, : first + 1], base[row, : first + 1])
+        assert (got[row, first:] == stop).all(), got[row]
