@@ -278,6 +278,7 @@ def generate_text(
     top_p: Optional[float] = None,
     seed: int = 0,
     tokenizer: Optional[str] = None,
+    stop_token: Optional[int] = None,
 ) -> str:
     """Mirror of the reference's `generate_text(model_path, input_text,
     max_new_tokens)` (generate_text.py:7): checkpoint -> text continuation.
@@ -293,6 +294,7 @@ def generate_text(
         top_p=top_p,
         seed=seed,
         tokenizer=tokenizer,
+        stop_token=stop_token,
     )[0]
 
 
@@ -306,11 +308,13 @@ def generate_text_batch(
     top_p: Optional[float] = None,
     seed: int = 0,
     tokenizer: Optional[str] = None,
+    stop_token: Optional[int] = None,
 ) -> list:
     """Batched continuation of DIFFERENT-length prompts in one compiled
     ragged decode (`generate(..., prompt_lengths=...)`) — one device
     program for the whole batch instead of a per-prompt loop. Returns one
-    continuation string per input."""
+    continuation string per input; a row's output TRUNCATES at (excludes)
+    its first ``stop_token``."""
     from pretraining_llm_tpu.data.tokenizer import get_tokenizer
 
     if not input_texts:
@@ -331,6 +335,17 @@ def generate_text_batch(
     batch = np.zeros((len(encoded), pmax), np.int32)
     for i, e in enumerate(encoded):
         batch[i, : len(e)] = e
+    # MoE models reject ragged rows (pad slots would compete for expert
+    # capacity); a uniform-length batch — incl. every single-prompt call —
+    # needs no ragged machinery, which keeps generate_text working for MoE.
+    uniform = bool((lengths == lengths[0]).all())
+    if cfg.model.n_experts and not uniform:
+        raise ValueError(
+            "MoE models require equal-length prompts per batch (ragged "
+            "left-pad slots would compete for expert capacity); generate "
+            "each prompt separately or group by length"
+        )
+    use_lengths = None if uniform else lengths
     out = np.asarray(
         generate(
             params,
@@ -341,9 +356,17 @@ def generate_text_batch(
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
-            prompt_lengths=lengths,
+            prompt_lengths=use_lengths,
+            stop_token=stop_token,
         )
     )
+
+    def ids(row: np.ndarray) -> list:
+        toks = row.tolist()
+        if stop_token is not None and stop_token in toks:
+            toks = toks[: toks.index(stop_token)]
+        return toks
+
     return [
-        t + enc.decode(out[i].tolist()) for i, t in enumerate(input_texts)
+        t + enc.decode(ids(out[i])) for i, t in enumerate(input_texts)
     ]

@@ -42,6 +42,10 @@ def main() -> None:
         "--tokenizer", default=None,
         help="override the tokenizer name stored in the checkpoint config",
     )
+    parser.add_argument(
+        "--stop_token", type=int, default=None,
+        help="token id that ends a row's generation (output truncates there)",
+    )
     args = parser.parse_args()
 
     if args.input_file:
@@ -58,6 +62,7 @@ def main() -> None:
             top_p=args.top_p,
             seed=args.seed,
             tokenizer=args.tokenizer,
+            stop_token=args.stop_token,
         )
         for text in outs:
             print(text)
@@ -73,6 +78,7 @@ def main() -> None:
         top_p=args.top_p,
         seed=args.seed,
         tokenizer=args.tokenizer,
+        stop_token=args.stop_token,
     )
     print(text)
 

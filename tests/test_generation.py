@@ -380,3 +380,36 @@ def test_stop_token_freezes_finished_rows(params):
         first = int(hits[0])
         np.testing.assert_array_equal(got[row, : first + 1], base[row, : first + 1])
         assert (got[row, first:] == stop).all(), got[row]
+
+
+def test_generate_text_works_for_moe_checkpoint(tmp_path):
+    """generate_text must keep working for MoE checkpoints: single-prompt
+    (uniform-length) batches bypass the ragged machinery MoE rejects."""
+    from pretraining_llm_tpu.generation.generate import (
+        generate_text,
+        generate_text_batch,
+    )
+    from pretraining_llm_tpu.training.trainer import Trainer
+
+    cfg = get_preset("tiny").with_overrides(
+        {
+            "model.vocab_size": 512,
+            "model.n_experts": 2,
+            "model.experts_per_token": 1,
+            "model.expert_capacity_factor": 4.0,
+            "data.tokenizer_name": "byte",
+            "train.train_steps": 2,
+            "train.checkpoint_interval": 0,
+            "train.eval_interval": 0,
+            "train.log_interval": 100,
+            "train.checkpoint_dir": str(tmp_path / "ck"),
+        }
+    )
+    Trainer(cfg, synthetic_data=True, resume=False).train()
+    text = generate_text(str(tmp_path / "ck"), "Hello", max_new_tokens=4, temperature=0.0)
+    assert text.startswith("Hello")
+    # Ragged (different-length) MoE batches are rejected with a clear error.
+    with pytest.raises(ValueError, match="equal-length"):
+        generate_text_batch(
+            str(tmp_path / "ck"), ["Hello", "ab"], max_new_tokens=4
+        )
