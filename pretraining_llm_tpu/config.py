@@ -106,8 +106,19 @@ class ModelConfig:
     # this many round-robin depth chunks, shrinking the pipeline bubble by
     # the same factor. 1 = plain GPipe. Requires microbatches >= stages.
     pipeline_interleave: int = 1
+    # KV-cache element type for decode: "compute" stores compute_dtype;
+    # "int8" quantizes K/V per (token, head) with an fp32 amax scale —
+    # halves persistent cache HBM vs bf16 (the serving memory term that
+    # scales with L*B*T). Prefill attention always runs on the unquantized
+    # local block; only decode-step reads dequantize.
+    kv_cache_dtype: str = "compute"  # compute | int8
 
     def __post_init__(self) -> None:
+        if self.kv_cache_dtype not in ("compute", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'compute' or 'int8', got "
+                f"{self.kv_cache_dtype!r}"
+            )
         if self.activation not in _ACTIVATIONS:
             raise ValueError(f"activation must be one of {_ACTIVATIONS}, got {self.activation!r}")
         if self.norm not in _NORMS:
