@@ -19,6 +19,7 @@ trainer's job (`device_prefetch` below double-buffers `jax.device_put`).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
@@ -94,6 +95,97 @@ class BatchIterator:
         self._rng.bit_generator.state = state["bit_generator"]
 
 
+def is_mixture(data_path: str) -> bool:
+    """True when ``data_path`` is a mixture spec, not a single file.
+
+    A comma marks a mixture — unless the whole string names an existing
+    file (escape hatch for pathological comma-containing filenames). The
+    single source of truth for every dispatch site (loader, trainer's
+    native-batcher routing)."""
+    return "," in data_path and not os.path.exists(data_path)
+
+
+def parse_mixture(spec: str) -> "list[Tuple[str, float]]":
+    """Parse a mixture spec: comma-separated ``path[:weight]`` entries.
+
+    "a.bin:3,b.bin:1" -> [("a.bin", 3.0), ("b.bin", 1.0)] (weights need not
+    normalize; omitted weight = 1). An entry whose ':' suffix is not a
+    number keeps the colon as part of the path (drive letters etc.);
+    malformed entries (empty path, dangling ':') raise with the offending
+    entry named instead of surfacing later as a file-not-found.
+    """
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        path, sep, w = entry.rpartition(":")
+        if sep:
+            if path and w and w.replace(".", "", 1).isdigit():
+                out.append((path, float(w)))
+                continue
+            if not path or not w:
+                raise ValueError(
+                    f"malformed mixture entry {entry!r} in {spec!r}: "
+                    "expected path[:weight]"
+                )
+            # Non-numeric suffix: the ':' belongs to the path itself.
+        out.append((entry, 1.0))
+    if not out:
+        raise ValueError(f"empty mixture spec {spec!r}")
+    return out
+
+
+class MixtureIterator:
+    """Weighted mixture over several token streams (beyond-reference: the
+    reference trains on exactly one memmap, data_loader.py:32).
+
+    Each batch row draws its SOURCE by weight, then a crop from that
+    source — all from ONE seeded generator, so the whole mixture state
+    checkpoints/resumes through a single RNG (``state``/``set_state``,
+    same contract as BatchIterator; works under DevicePrefetcher's
+    consumed-frontier tracking unchanged).
+    """
+
+    def __init__(
+        self,
+        sources: "list[MemmapTokens]",
+        weights: "list[float]",
+        batch_size: int,
+        seed: int,
+    ) -> None:
+        if len(sources) != len(weights) or not sources:
+            raise ValueError("sources and weights must be equal-length, non-empty")
+        total = float(sum(weights))
+        if total <= 0 or any(w < 0 for w in weights):
+            raise ValueError(f"mixture weights must be >= 0 with a positive sum: {weights}")
+        self.sources = sources
+        self.weights = np.asarray([w / total for w in weights], np.float64)
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> "MixtureIterator":
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        choice = self._rng.choice(
+            len(self.sources), size=self.batch_size, p=self.weights
+        )
+        t = self.sources[0].context_length
+        xs = np.empty((self.batch_size, t), np.int32)
+        ys = np.empty((self.batch_size, t), np.int32)
+        for si in range(len(self.sources)):
+            rows = np.nonzero(choice == si)[0]
+            if rows.size:
+                x, y = self.sources[si].sample_batch(self._rng, rows.size)
+                xs[rows] = x
+                ys[rows] = y
+        return xs, ys
+
+    state = BatchIterator.state
+    set_state = BatchIterator.set_state
+
+
 def get_batch_iterator(
     data_path: str,
     batch_size: int,
@@ -102,12 +194,27 @@ def get_batch_iterator(
     seed: int = 1337,
     shard_index: int = 0,
     shard_count: int = 1,
-) -> BatchIterator:
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Mirror of the reference's public API (data_loader.py:7-15), returning
-    host numpy batches; sharding is contiguous-block, sampling is seeded."""
-    source = MemmapTokens(data_path, context_length, shard_index, shard_count)
+    host numpy batches; sharding is contiguous-block, sampling is seeded.
+
+    ``data_path`` may be a weighted mixture spec — comma-separated
+    ``path[:weight]`` (see `parse_mixture`); each source is host-sharded
+    contiguously as usual.
+    """
     # Decorrelate shards: each host folds its index into the stream seed.
-    return BatchIterator(source, batch_size, seed + 7919 * shard_index)
+    host_seed = seed + 7919 * shard_index
+    if is_mixture(data_path):
+        entries = parse_mixture(data_path)
+        sources = [
+            MemmapTokens(p, context_length, shard_index, shard_count)
+            for p, _ in entries
+        ]
+        return MixtureIterator(
+            sources, [w for _, w in entries], batch_size, host_seed
+        )
+    source = MemmapTokens(data_path, context_length, shard_index, shard_count)
+    return BatchIterator(source, batch_size, host_seed)
 
 
 class SyntheticTokens:

@@ -178,7 +178,9 @@ class Trainer:
         """
         dcfg, tcfg, mcfg = self.config.data, self.config.train, self.config.model
         local_batch = tcfg.batch_size // jax.process_count()
-        if dcfg.use_native_batcher:
+        # Mixture specs ("a.bin:3,b.bin:1") route through the numpy
+        # MixtureIterator; the native batcher reads exactly one memmap.
+        if dcfg.use_native_batcher and not data_loader.is_mixture(path):
             try:
                 from pretraining_llm_tpu.data.native_batcher import NativeBatchIterator
 
