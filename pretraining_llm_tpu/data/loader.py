@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from typing import Any, Dict, Iterator, Optional, Tuple
+from typing import Any, Dict, Iterator, Tuple
 
 import numpy as np
 

@@ -19,7 +19,6 @@ sharding rules treat it uniformly.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
