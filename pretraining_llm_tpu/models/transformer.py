@@ -268,6 +268,41 @@ def _attention_block(
                 q, k, v, impl="flash",
                 block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
             )
+        elif (
+            tq > 1
+            and pad_offsets is None
+            and cfg.attention_impl in ("flash", "ring", "ulysses")
+        ):
+            # CHUNKED prefill (traced or nonzero offset): rectangular
+            # blockwise attention of this chunk's queries (positions
+            # [cache_index, cache_index+tq)) against the cache —
+            # O(block) transient memory instead of materialized
+            # (Tq, Tmax) masked scores, GQA-native (grouped cache, never
+            # expanded). No explicit length mask needed: slots at/above
+            # the write frontier sit at positions > every query position,
+            # so causality alone excludes them, and slots below hold the
+            # valid prefix written by earlier chunks.
+            from pretraining_llm_tpu.ops.flash_attention import blockwise_attention
+
+            kv_view = new_kv
+            if not isinstance(cache_index, jax.core.Tracer):
+                # Concrete offset (host-side chunk loops): slice off the
+                # key blocks that lie entirely beyond the frontier before
+                # dequant/attention — they would contribute only masked
+                # scores (~2x the needed FLOPs on a mid-cache chunk).
+                # Round up to the configured KV tile so the slice never
+                # shrinks the block _pick_block would choose.
+                tile = cfg.flash_block_kv or 512
+                hi = min(tmax, -(-(int(cache_index) + tq) // tile) * tile)
+                kv_view = {
+                    name: buf[:, :hi] for name, buf in new_kv.items()
+                }
+            ck, cv = _materialize_cache(kv_view, quantized, cdt)
+            out = blockwise_attention(
+                q, ck, cv, causal=True,
+                block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
+                q_offset=cache_index,
+            )
         else:
             kv_positions = jnp.arange(tmax)
             kv_mask = (kv_positions < cache_index + tq)[None, :]
@@ -275,12 +310,7 @@ def _attention_block(
                 # Ragged rows: slots below each row's left-pad offset are
                 # dead (never written with real tokens) — mask them out.
                 kv_mask = kv_mask & (kv_positions[None, :] >= pad_offsets[:, None])
-            if quantized:
-                cache_k = _kv_dequantize(new_kv["k"], new_kv["k_scale"], cdt)
-                cache_v = _kv_dequantize(new_kv["v"], new_kv["v_scale"], cdt)
-            else:
-                cache_k = new_kv["k"].astype(cdt)
-                cache_v = new_kv["v"].astype(cdt)
+            cache_k, cache_v = _materialize_cache(new_kv, quantized, cdt)
             out = multihead_attention(
                 q,
                 cache_k,
@@ -418,9 +448,10 @@ def forward(
     ``cache_index``; the updated cache is returned. Cached calls with T>1
     and a provably-zero ``cache_index`` (a concrete 0, as the generate
     prefill passes) take the flash-prefill shortcut under
-    ``attention_impl != 'naive'``; a traced or nonzero offset (chunked
-    prefill) automatically keeps the masked-einsum path that attends the
-    cached prefix.
+    ``attention_impl != 'naive'``; a traced or nonzero offset (CHUNKED
+    prefill) routes through rectangular blockwise attention against the
+    cache — O(block) transient memory at any offset. impl='naive' keeps
+    the masked einsum everywhere.
 
     ``return_hidden=True`` additionally returns intermediate activations
     {'block_outputs': (L, B, T, D), 'final_hidden': (B, T, D)} — the
@@ -793,3 +824,15 @@ def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 def _kv_dequantize(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
     return (q.astype(jnp.float32) * (scale * (1.0 / 127.0))).astype(dtype)
+
+
+def _materialize_cache(kv: Params, quantized: bool, dtype: Any):
+    """(k, v) in compute dtype from a (possibly int8-quantized, possibly
+    sliced) cache view — the single dequant point for every cached-attention
+    read path."""
+    if quantized:
+        return (
+            _kv_dequantize(kv["k"], kv["k_scale"], dtype),
+            _kv_dequantize(kv["v"], kv["v_scale"], dtype),
+        )
+    return kv["k"].astype(dtype), kv["v"].astype(dtype)

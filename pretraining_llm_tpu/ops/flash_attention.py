@@ -17,6 +17,7 @@ caps its context at 512. Two tiers:
 from __future__ import annotations
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -43,17 +44,32 @@ def blockwise_attention(
     causal: bool = True,
     block_q: int = 0,
     block_kv: int = 0,
+    q_offset: Any = 0,
 ) -> jax.Array:
-    """Online-softmax attention. q, k, v: (B, T, H, Dh) -> (B, T, H, Dh)."""
-    b, t, h, dh = q.shape
-    bq = _pick_block(t, block_q, 512)
-    bk = _pick_block(t, block_kv, 512)
-    nq, nk = t // bq, t // bk
+    """Online-softmax attention. q: (B, Tq, H, Dh), k/v: (B, Tk, G, Dh)
+    with G | H -> (B, Tq, H, Dh). Tq and Tk may differ.
+
+    GQA-NATIVE: each group of H/G query heads attends its shared KV head
+    through grouped einsums — K/V are never expanded to H heads (the
+    cache-bandwidth win GQA exists for). G == H reduces to plain MHA.
+
+    ``q_offset`` (python int or traced scalar) places the query block at
+    positions [q_offset, q_offset+Tq) against keys at [0, Tk) for the
+    causal mask — the rectangular form chunked prefill needs (each chunk
+    attends the already-written cache prefix; keys above the frontier are
+    causally excluded, so no explicit length mask is required).
+    """
+    b, tq_len, h, dh = q.shape
+    tk_len, g = k.shape[1], k.shape[2]
+    r = h // g  # query heads per KV group
+    bq = _pick_block(tq_len, block_q, 512)
+    bk = _pick_block(tk_len, block_kv, 512)
+    nq, nk = tq_len // bq, tk_len // bk
     scale = 1.0 / (dh**0.5)
 
-    qb = q.reshape(b, nq, bq, h, dh)
-    kb = k.reshape(b, nk, bk, h, dh)
-    vb = v.reshape(b, nk, bk, h, dh)
+    qb = q.reshape(b, nq, bq, g, r, dh)
+    kb = k.reshape(b, nk, bk, g, dh)
+    vb = v.reshape(b, nk, bk, g, dh)
 
     q_ids = jnp.arange(bq)
     k_ids = jnp.arange(bk)
@@ -63,15 +79,18 @@ def blockwise_attention(
         o, m, l, qi, q_block = carry
         kj, k_block, v_block = inputs
         s = (
-            jnp.einsum("bqhd,bkhd->bhqk", q_block, k_block, preferred_element_type=jnp.float32)
+            jnp.einsum(
+                "bqgrd,bkgd->bgrqk", q_block, k_block,
+                preferred_element_type=jnp.float32,
+            )
             * scale
-        )  # (B, H, bq, bk) fp32
+        )  # (B, G, R, bq, bk) fp32
         if causal:
-            q_pos = qi * bq + q_ids  # (bq,)
+            q_pos = q_offset + qi * bq + q_ids  # (bq,)
             k_pos = kj * bk + k_ids  # (bk,)
             mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, H, bq)
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, G, R, bq)
         # exp(-inf - -inf) guard: rows of a fully-masked block keep m = -inf
         p = jnp.exp(s - m_new[..., None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
@@ -79,24 +98,24 @@ def blockwise_attention(
         alpha = jnp.where(jnp.isfinite(m) | jnp.isfinite(m_new), alpha, 0.0)
         l = l * alpha + jnp.sum(p, axis=-1)
         pv = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_block.dtype), v_block,
+            "bgrqk,bkgd->bqgrd", p.astype(v_block.dtype), v_block,
             preferred_element_type=jnp.float32,
         )
-        o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        o = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
         return (o, m_new, l, qi, q_block), None
 
     def q_block_fn(qi, q_block):
-        o0 = jnp.zeros((b, bq, h, dh), jnp.float32)
-        m0 = jnp.full((b, h, bq), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((b, h, bq), jnp.float32)
+        o0 = jnp.zeros((b, bq, g, r, dh), jnp.float32)
+        m0 = jnp.full((b, g, r, bq), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, g, r, bq), jnp.float32)
         (o, m, l, _, _), _ = jax.lax.scan(
             kv_step, (o0, m0, l0, qi, q_block), (jnp.arange(nk), kb.swapaxes(0, 1), vb.swapaxes(0, 1))
         )
-        return o / l.transpose(0, 2, 1)[..., None]
+        return o / l.transpose(0, 3, 1, 2)[..., None]
 
     out = jax.lax.map(lambda args: q_block_fn(*args), (jnp.arange(nq), qb.swapaxes(0, 1)))
-    # out: (nq, B, bq, H, Dh) -> (B, T, H, Dh)
-    return out.transpose(1, 0, 2, 3, 4).reshape(b, t, h, dh).astype(q.dtype)
+    # out: (nq, B, bq, G, R, Dh) -> (B, Tq, H, Dh)
+    return out.transpose(1, 0, 2, 3, 4, 5).reshape(b, tq_len, h, dh).astype(q.dtype)
 
 
 @functools.lru_cache(maxsize=1)
@@ -153,10 +172,9 @@ def flash_attention(
 
     q: (B, T, H, D); k, v: (B, T, G, D) with G | H. The Pallas kernel handles
     GQA natively (query groups index shared KV blocks); the blockwise
-    fallback expands K/V — correctness-only, it runs on CPU/test paths.
+    fallback is GQA-native too (grouped einsums, K/V never expanded).
     """
-    gqa = k.shape[2] != q.shape[2]
-    if gqa and q.shape[2] % k.shape[2] != 0:
+    if q.shape[2] % k.shape[2] != 0:
         # Same fail-fast the Pallas path gives; without it the CPU fallback
         # dies in an unrelated reshape.
         raise ValueError(f"kv heads ({k.shape[2]}) must divide query heads ({q.shape[2]})")
@@ -216,8 +234,5 @@ def flash_attention(
             )
         except ImportError:
             pass  # kernel module not built yet; blockwise path is correct
-    if gqa:
-        n_rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
+    # blockwise_attention is GQA-native (grouped einsums) — no K/V expansion.
     return blockwise_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
