@@ -574,6 +574,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
     last_err = "no attempts made (timeout budget too small?)"
     best = None
     last_error_rec = None
+    wedged = False
     transient_markers = (
         "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
         "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
@@ -584,6 +585,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
         remaining = deadline - time.monotonic()
         cand_deadline = time.monotonic() + remaining / (len(candidates) - ci)
         backoff = 10.0
+        cand_hangs = 0
         while True:
             remaining = cand_deadline - time.monotonic()
             if remaining <= 5:
@@ -602,19 +604,63 @@ def wrapper_main(args: argparse.Namespace) -> int:
             if rec is not None:
                 last_error_rec = rec
             print(f"[bench] {last_err}", file=sys.stderr)
-            # Hangs while racing are treated as deterministic (the known
-            # compile-pathology mode) — move to the safe candidate instead
-            # of re-burning the reserved budget; single-candidate runs keep
-            # retrying hangs (tunnel flakes) until the budget runs out.
-            transient = any(m in err for m in transient_markers) or (
-                "hung" in err and not race
-            )
+            if "hung" in err:
+                cand_hangs += 1
+                # Measured-on-chip failure mode (round 3): killing a client
+                # that hung MID-STEP leaves the backend unacquirable — every
+                # later attempt then hangs at device acquisition and burns
+                # its full timeout learning nothing. Classify with a cheap
+                # canary before spending more budget.
+                ok, detail = _run_canary(min(args.canary_timeout, max(deadline - time.monotonic(), 30)))
+                if not ok:
+                    if best is not None:
+                        # A result is already banked: report it NOW rather
+                        # than polling a wedged backend for the rest of the
+                        # budget (the remaining candidates could only have
+                        # improved the number, not rescued the round).
+                        print(f"[bench] post-hang canary: {detail} — backend "
+                              "wedged; reporting the already-banked result",
+                              file=sys.stderr)
+                        # Mark the banked record: callers chaining further
+                        # --skip-canary runs (scripts/tpu_capture.py) must
+                        # know the backend was left dead despite rc=0.
+                        best["backend_wedged"] = True
+                        wedged = True
+                        break
+                    print(f"[bench] post-hang canary: {detail} — backend wedged; "
+                          "polling for recovery instead of burning attempts",
+                          file=sys.stderr)
+                    # Poll cheap canaries (not full attempts) until the
+                    # backend answers or the whole budget is gone.
+                    while time.monotonic() + 60 < deadline:
+                        time.sleep(45)
+                        ok, detail = _run_canary(
+                            min(args.canary_timeout, max(deadline - time.monotonic(), 30)))
+                        if ok:
+                            print("[bench] backend recovered; resuming", file=sys.stderr)
+                            break
+                    if not ok:
+                        wedged = True
+                        last_err += " (backend wedged after the kill; never recovered in budget)"
+                        break
+                    if cand_hangs >= 2:
+                        break  # hung twice: this program is the problem
+                    continue  # recovered: one retry of this candidate
+                # Canary alive: the hang was this program or a transient
+                # stall, not the backend. One retry (budget share permitting);
+                # a second hang abandons the candidate.
+                if cand_hangs >= 2:
+                    break
+                continue
+            transient = any(m in err for m in transient_markers)
             if not transient:
                 break
             if time.monotonic() + backoff >= cand_deadline:
                 break
             time.sleep(backoff)
             backoff = min(backoff * 2, 120.0)
+        if wedged:
+            break
         if race and best is not None and ci >= 1:
             break  # a success after the newest policy: later rungs are slower
     if best is not None:
@@ -622,12 +668,15 @@ def wrapper_main(args: argparse.Namespace) -> int:
             best.setdefault("canary_s", canary_info.get("canary_s"))
         print(json.dumps(best))
         return 0
-    if last_error_rec is not None:
+    if last_error_rec is not None and not wedged:
         # Relay the inner run's full structured error line untouched —
         # race or not (ADVICE r2 low #3).
         print(json.dumps(last_error_rec))
         return 1
-    print(json.dumps(error_result(args, last_err, attempts)))
+    rec = error_result(args, last_err, attempts)
+    if wedged:
+        rec["environment_error"] = True
+    print(json.dumps(rec))
     return 1
 
 

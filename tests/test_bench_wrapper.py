@@ -1,0 +1,189 @@
+"""Unit tests for bench.py's candidate-racing wrapper.
+
+The wrapper is the driver's only window onto the chip; its failure handling
+is load-bearing (round-2 recorded an unattributable 0.0 for the whole round).
+These tests drive `wrapper_main` with monkeypatched `_attempt`/`_run_canary`
+to pin the round-3 on-chip lessons:
+
+  * a hung attempt triggers a cheap canary before more budget is spent;
+  * a wedged backend (canary dead after the kill) is polled for recovery
+    instead of burning full attempt timeouts, and reported as an
+    ENVIRONMENT error if it never returns;
+  * a candidate that hangs twice (with recovery between) is abandoned —
+    retrying a chip-wedging program forever would wedge the chip forever.
+"""
+
+import json
+
+import bench
+
+
+class _FakeTime:
+    """Deterministic clock: sleep() advances it, monotonic() reads it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+    def perf_counter(self):  # pragma: no cover - not used by the wrapper
+        return self.t
+
+
+def _wrapper_args(**over):
+    opts = {"preset": "gpt2-124m", "timeout_budget": "600"}
+    opts.update({k: str(v) for k, v in over.items()})
+    argv = ["--skip-canary"]
+    for k, v in opts.items():
+        argv += [f"--{k.replace('_', '-')}", v]
+    return bench.parse_args(argv)
+
+
+def _run(monkeypatch, capsys, attempts_script, canary_script, args=None):
+    """Run wrapper_main with scripted attempt/canary outcomes.
+
+    attempts_script: list of (rec|None, err) popped per _attempt call; a hang
+    advances the fake clock by the attempt timeout (like a real kill would).
+    canary_script: list of (ok, detail) popped per _run_canary call; the
+    last entry repeats forever.
+    """
+    ft = _FakeTime()
+    monkeypatch.setattr(bench, "time", ft)
+    calls = {"attempts": [], "canaries": 0}
+
+    def fake_attempt(a, remat, timeout, attention=""):
+        rec, err = attempts_script.pop(0)
+        calls["attempts"].append((remat, attention))
+        ft.sleep(timeout if "hung" in err else 5.0)
+        return rec, err
+
+    def fake_canary(timeout):
+        i = min(calls["canaries"], len(canary_script) - 1)
+        calls["canaries"] += 1
+        ft.sleep(5.0 if canary_script[i][0] else timeout)
+        return canary_script[i]
+
+    monkeypatch.setattr(bench, "_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_run_canary", fake_canary)
+    rc = bench.wrapper_main(args or _wrapper_args())
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out), calls
+
+
+def _ok(value, remat):
+    return ({"metric": "mfu_gpt2-124m_train", "value": value,
+             "unit": "fraction_of_peak_bf16", "vs_baseline": value / 0.5,
+             "remat": remat}, "")
+
+
+HUNG = (None, "hung past 150s (killed)")
+
+
+def test_hang_with_live_canary_moves_to_next_candidate(monkeypatch, capsys):
+    # Candidate 1 hangs; canary says the backend is fine => the program was
+    # the problem; candidate 2 succeeds and is reported.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[HUNG, _ok(0.41, "save_attn")],
+        canary_script=[(True, {"ok": True})],
+    )
+    assert rc == 0
+    assert rec["value"] == 0.41
+    assert [r for r, _ in calls["attempts"]] == ["save_big", "save_attn"]
+    assert calls["canaries"] == 1  # exactly one cheap probe after the hang
+
+
+def test_wedged_backend_is_an_environment_error(monkeypatch, capsys):
+    # Hang, then the canary never answers again: the wrapper must poll
+    # canaries (not burn full attempts) and report an environment error.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[HUNG],
+        canary_script=[(False, "canary hung past 150s (backend unreachable)")],
+    )
+    assert rc == 1
+    assert rec["value"] == 0.0
+    assert rec.get("environment_error") is True
+    assert "wedged" in rec["error"]
+    # Only the first attempt burned a full timeout; everything after was
+    # cheap canary polls.
+    assert len(calls["attempts"]) == 1
+    assert calls["canaries"] >= 2
+
+
+def test_wedged_then_recovered_retries_same_candidate(monkeypatch, capsys):
+    # Hang -> canary dead -> canary recovers -> the SAME candidate gets one
+    # retry and succeeds. (Budget must outlive the burnt share: a hang costs
+    # min(attempt_timeout, share), so share > 2*attempt_timeout + polls.)
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[HUNG, _ok(0.40, "save_big"), _ok(0.38, "save_attn")],
+        canary_script=[(False, "dead"), (True, {"ok": True})],
+        args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
+    )
+    assert rc == 0
+    assert rec["value"] == 0.40  # best of the race, from the retried candidate
+    assert [r for r, _ in calls["attempts"]] == [
+        "save_big", "save_big", "save_attn"]
+
+
+def test_double_hang_abandons_candidate(monkeypatch, capsys):
+    # A candidate that hangs twice (backend recovering in between) is the
+    # problem itself; the wrapper must move on, not wedge the chip a third
+    # time.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[HUNG, HUNG, _ok(0.39, "save_attn")],
+        canary_script=[(False, "dead"), (True, {"ok": True})],
+        args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
+    )
+    assert rc == 0
+    assert rec["value"] == 0.39
+    assert [r for r, _ in calls["attempts"]] == [
+        "save_big", "save_big", "save_attn"]
+
+
+def test_wedge_with_banked_result_reports_it_immediately(monkeypatch, capsys):
+    # Candidate 1 already banked a number; candidate 2 hangs and wedges the
+    # backend. The wrapper must report the banked result NOW, not poll the
+    # dead backend for the rest of the budget.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[_ok(0.30, "save_big"), HUNG],
+        canary_script=[(False, "dead")],
+    )
+    assert rc == 0
+    assert rec["value"] == 0.30
+    assert len(calls["attempts"]) == 2
+    assert calls["canaries"] == 1  # one classifying probe, zero polling
+
+
+def test_race_reports_best_of_successes(monkeypatch, capsys):
+    # Both new policies succeed: the better number wins and the known-good
+    # tail is never run (budget preserved).
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[_ok(0.30, "save_big"), _ok(0.41, "save_attn")],
+        canary_script=[(True, {"ok": True})],
+    )
+    assert rc == 0
+    assert rec["value"] == 0.41
+    assert [r for r, _ in calls["attempts"]] == ["save_big", "save_attn"]
+
+
+def test_structured_inner_error_is_relayed(monkeypatch, capsys):
+    # Deterministic inner failures relay the inner run's structured record.
+    inner = {"metric": "mfu_gpt2-124m_train", "value": 0.0,
+             "unit": "fraction_of_peak_bf16", "vs_baseline": 0.0,
+             "error": "RuntimeError: boom", "attempts": 1}
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[(inner, "rc=1: RuntimeError")] * 4,
+        canary_script=[(True, {"ok": True})],
+    )
+    assert rc == 1
+    assert rec["error"] == "RuntimeError: boom"
