@@ -81,6 +81,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
+        "--block-q", type=int, default=0,
+        help="flash kernel q-block override (0 = auto). Smaller blocks at "
+        "short T let the causal whole-block skip drop masked work the "
+        "single-block layout must compute then discard.",
+    )
+    parser.add_argument(
+        "--block-kv", type=int, default=0, help="flash kernel kv-block override"
+    )
+    parser.add_argument(
         "--timeout-budget",
         type=float,
         default=1800.0,
@@ -243,6 +252,10 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
         model = dc.replace(model, remat="save_attn")
     if args.ce:
         model = dc.replace(model, ce_impl=args.ce)
+    if args.block_q or args.block_kv:
+        model = dc.replace(
+            model, flash_block_q=args.block_q, flash_block_kv=args.block_kv
+        )
     batch = args.batch or (24 if args.preset == "gpt2-124m" else cfg.train.batch_size)
     steps = 8 if args.quick else max(args.steps, 10)
     if args.quick:
@@ -332,6 +345,10 @@ def run_bench(args: argparse.Namespace) -> dict:
         model = dataclasses.replace(model, attention_impl="flash", sequence_parallel=False)
     if args.unroll:
         model = dataclasses.replace(model, scan_unroll=args.unroll)
+    if args.block_q or args.block_kv:
+        model = dataclasses.replace(
+            model, flash_block_q=args.block_q, flash_block_kv=args.block_kv
+        )
     if args.ce:
         model = dataclasses.replace(model, ce_impl=args.ce)
     if args.remat:
@@ -497,6 +514,10 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--remat", remat]
     if args.unroll:
         cmd += ["--unroll", str(args.unroll)]
+    if args.block_q:
+        cmd += ["--block-q", str(args.block_q)]
+    if args.block_kv:
+        cmd += ["--block-kv", str(args.block_kv)]
     try:
         proc = subprocess.run(
             cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout, text=True

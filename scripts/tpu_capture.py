@@ -65,13 +65,21 @@ def wait_for_backend(out_f, wait_pool: dict):
     the backend is down.
     """
     t0 = time.time()
-    rec = _canary_probe()
+
+    def _pool_bounded_timeout() -> float:
+        # Every probe — including the initial two — is bounded by the pool,
+        # so --recovery-wait is a real cap even when canaries hang for their
+        # full timeout (a 150s default probe must not overrun a nearly-dry
+        # pool). The 5s floor keeps a healthy-but-slow probe classifiable.
+        return min(150.0, max(5.0, wait_pool["remaining"] - (time.time() - t0)))
+
+    rec = _canary_probe(timeout=_pool_bounded_timeout())
     if rec is not None:
         return rec
     # One immediate retry before declaring an outage: a single canary flake
     # on the intermittent tunnel must not impose the 120s outage cadence or
     # drain the shared pool (same rationale as bench.py's 2-try gate).
-    rec = _canary_probe()
+    rec = _canary_probe(timeout=_pool_bounded_timeout())
     if rec is not None:
         return rec
     print("[capture] backend not answering; polling for recovery", flush=True)
@@ -79,8 +87,7 @@ def wait_for_backend(out_f, wait_pool: dict):
         time.sleep(min(120, max(1.0, wait_pool["remaining"] - (time.time() - t0))))
         # Bound each probe by the remaining pool so --recovery-wait is a
         # real cap, not a lower bound (a hanging canary burns 150s/probe).
-        rec = _canary_probe(
-            timeout=min(150.0, max(30.0, wait_pool["remaining"] - (time.time() - t0))))
+        rec = _canary_probe(timeout=_pool_bounded_timeout())
         if rec is not None:
             waited = round(time.time() - t0, 1)
             wait_pool["remaining"] -= waited
@@ -150,7 +157,7 @@ def main() -> int:
     args = ap.parse_args()
     KNOWN = {
         "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
-        "sweep-full",
+        "sweep-full", "sweep2", "profile",
     }
     want = None
     if args.stages:
@@ -246,6 +253,38 @@ def _run_stages(args, on, gated, py) -> None:
                  "--batch", str(batch), "--timeout-budget", "900"],
                 1020,
             )
+
+    # 3b. Second-wave sweep: the points the first on-chip session never
+    # reached. save_qkv_attn (between save_attn's recompute and save_big's
+    # HBM cost) was never raced on chip; smaller flash blocks at T=1024 let
+    # the causal whole-block skip actually drop masked work (one 1024^2
+    # block computes the FULL square; 4x 512^2 blocks skip 1/4, 256^2 skip
+    # 3/8) — uncredited FLOPs under the /2 causal accounting; batch 48
+    # probes whether matmul efficiency keeps climbing past 32.
+    if on("sweep2"):
+        for extra in (
+            ["--remat", "save_qkv_attn"],
+            ["--remat", "save_qkv_attn", "--batch", "32"],
+            ["--remat", "save_attn", "--block-q", "512", "--block-kv", "512"],
+            ["--remat", "save_attn", "--block-q", "256", "--block-kv", "256"],
+            ["--remat", "save_attn", "--batch", "48"],
+        ):
+            gated(
+                "sweep2:" + "/".join(extra).replace("--", ""),
+                [py, BENCH, "--skip-canary", "--timeout-budget", "900"] + extra,
+                1020,
+            )
+
+    # 3c. Op-level trace at the measured-best config: the ground truth for
+    # what to attack next (prints the top HLO ops by self time).
+    if on("profile"):
+        gated(
+            "profile",
+            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
+             "--preset", "gpt2-124m", "--batch", "24",
+             "--remat", "save_attn", "--top", "40"],
+            900,
+        )
 
     # 4. Decode throughput: dense bucketed + ragged serving shape.
     if on("decode"):
