@@ -82,12 +82,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
         "--block-q", type=int, default=0,
-        help="flash kernel q-block override (0 = auto). Smaller blocks at "
-        "short T let the causal whole-block skip drop masked work the "
-        "single-block layout must compute then discard.",
+        help="flash kernel q-block override (0 = auto). WARNING: measured "
+        "2026-07-31 on the axon v5e backend, 512x512 blocks at T=1024 HUNG "
+        "the chip (Mosaic-class wedge, multi-hour backend outage after the "
+        "kill) — the auto block size is the only proven-safe layout there.",
     )
     parser.add_argument(
-        "--block-kv", type=int, default=0, help="flash kernel kv-block override"
+        "--block-kv", type=int, default=0,
+        help="flash kernel kv-block override (same hang warning as --block-q)"
     )
     parser.add_argument(
         "--timeout-budget",

@@ -188,7 +188,8 @@ def run_jax(args, model_cfg, train_path, val_path, init_npz):
             print(f"[jax] step {s+1} loss {curve[-1]['loss']:.4f}", flush=True)
     final_eval = eval_loss(state["params"])
     print(f"[jax] final eval loss {final_eval:.4f}")
-    return {"curve": curve, "eval_loss": final_eval, "backend": jax.default_backend()}
+    return {"curve": curve, "eval_loss": final_eval, "backend": jax.default_backend(),
+            "steps": args.steps}
 
 
 # ---------------------------------------------------------------------------
@@ -303,7 +304,8 @@ def run_torch(args, model_cfg, train_path, val_path, init_npz):
             total += ce(torch.from_numpy(x).long(), torch.from_numpy(y).long()).item()
     final_eval = total / args.eval_iters
     print(f"[torch] final eval loss {final_eval:.4f}")
-    return {"curve": curve, "eval_loss": final_eval, "backend": "torch-cpu"}
+    return {"curve": curve, "eval_loss": final_eval, "backend": "torch-cpu",
+            "steps": args.steps}
 
 
 # ---------------------------------------------------------------------------
@@ -336,9 +338,35 @@ def main():
         tokenize_corpus(corpus, train_bin, val_bin)
         print(f"corpus: {n/1e6:.2f} MB real text -> {train_bin}")
 
+    def _steps_of(rec):
+        if rec.get("steps") is not None:
+            return rec["steps"]
+        curve = rec.get("curve") or []
+        return curve[-1]["step"] if curve else None  # pre-"steps" records
+
     results = {}
     if os.path.exists(results_path):
         results = json.load(open(results_path))
+
+    # The delta only means something when both twins trained the same number
+    # of steps — and a partial --only rerun at a different --steps must be
+    # refused BEFORE it trains and overwrites the banked matching record
+    # (this exact mistake produced a spurious "delta 1.1571 FAIL" and
+    # destroyed a 1500-step record: a 300-step `--only jax` rerun compared
+    # against — and clobbered — the recorded 1500-step twin).
+    if args.only in ("jax", "torch"):
+        other = results.get({"jax": "torch", "torch": "jax"}[args.only])
+        so = _steps_of(other) if other else None
+        if so is not None and so != args.steps:
+            print(json.dumps({
+                "error": f"step-count mismatch: --only {args.only} with "
+                         f"--steps {args.steps}, but the recorded "
+                         f"{'torch' if args.only == 'jax' else 'jax'} twin "
+                         f"ran {so} steps; rerun with --steps {so} (or "
+                         "retrain both sides)",
+            }))
+            return 2
+
     if args.only in ("", "jax"):
         results["jax"] = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
     if args.only in ("", "torch"):
@@ -346,13 +374,24 @@ def main():
     json.dump(results, open(results_path, "w"), indent=2)
 
     if "jax" in results and "torch" in results:
+        sj = _steps_of(results["jax"])
+        st = _steps_of(results["torch"])
+        if sj is not None and st is not None and sj != st:
+            # Belt-and-braces: records can still disagree (hand-edited file).
+            print(json.dumps({
+                "error": f"step-count mismatch: jax ran {sj} steps, torch ran "
+                         f"{st}; rerun the shorter side with --steps "
+                         f"{max(sj, st)} (or both with matching --steps)",
+            }))
+            return 2
         ja, to = results["jax"]["eval_loss"], results["torch"]["eval_loss"]
         delta = abs(ja - to)
         print("\n=== PARITY ===")
         print(f"jax  ({results['jax']['backend']}): eval loss {ja:.4f}")
         print(f"torch (cpu fp32 baseline):          eval loss {to:.4f}")
         print(f"delta {delta:.4f}  ({'PASS' if delta <= 0.01 else 'FAIL'} at +-0.01)")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

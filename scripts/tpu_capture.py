@@ -157,7 +157,7 @@ def main() -> int:
     args = ap.parse_args()
     KNOWN = {
         "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
-        "sweep-full", "sweep2", "profile",
+        "sweep-full", "sweep2", "profile", "e2e", "batch-sweep",
     }
     want = None
     if args.stages:
@@ -254,25 +254,41 @@ def _run_stages(args, on, gated, py) -> None:
                 1020,
             )
 
-    # 3b. Second-wave sweep: the points the first on-chip session never
-    # reached. save_qkv_attn (between save_attn's recompute and save_big's
-    # HBM cost) was never raced on chip; smaller flash blocks at T=1024 let
-    # the causal whole-block skip actually drop masked work (one 1024^2
-    # block computes the FULL square; 4x 512^2 blocks skip 1/4, 256^2 skip
-    # 3/8) — uncredited FLOPs under the /2 causal accounting; batch 48
-    # probes whether matmul efficiency keeps climbing past 32.
+    # 3b. Second-wave sweep: remaining unmeasured points — batch 48 (does
+    # throughput keep falling past 32?) and the 8k preset under the remat
+    # policies that won at 1k context.
     if on("sweep2"):
+        # Measured 2026-07-31: save_qkv_attn/b24 0.3964, /b32 0.3928 (loses
+        # to save_attn 0.4059 — saving more residuals costs more HBM than
+        # the recompute it avoids). --block-q 512 --block-kv 512 at T=1024
+        # HUNG the chip (killed at 700s; same Mosaic-class wedge as
+        # save_attn+fused) — block overrides are now excluded from
+        # campaigns; the auto block size stands.
         for extra in (
-            ["--remat", "save_qkv_attn"],
-            ["--remat", "save_qkv_attn", "--batch", "32"],
-            ["--remat", "save_attn", "--block-q", "512", "--block-kv", "512"],
-            ["--remat", "save_attn", "--block-q", "256", "--block-kv", "256"],
             ["--remat", "save_attn", "--batch", "48"],
+            # The 8k preset's remat is dots_saveable (0.2475 measured);
+            # save_attn won every gpt2-124m point — try it at 8k too.
+            ["--preset", "gpt2-8k-sp", "--remat", "save_attn"],
+            ["--preset", "gpt2-8k-sp", "--remat", "save_big"],
         ):
             gated(
                 "sweep2:" + "/".join(extra).replace("--", ""),
                 [py, BENCH, "--skip-canary", "--timeout-budget", "900"] + extra,
                 1020,
+            )
+
+    # 3b2. Batch micro-sweep around the wave-1 winner (b16 > b24 > b32 at
+    # save_attn/chunked): find the throughput knee. (No block-size points:
+    # block overrides hang this backend — see the sweep2 comment above.)
+    if on("batch-sweep"):
+        for extra in (
+            ["--batch", "8"], ["--batch", "12"], ["--batch", "20"],
+        ):
+            gated(
+                "bsweep:" + "/".join(extra).replace("--", ""),
+                [py, BENCH, "--skip-canary", "--remat", "save_attn",
+                 "--timeout-budget", "700"] + extra,
+                820,
             )
 
     # 3c. Op-level trace at the measured-best config: the ground truth for
@@ -317,11 +333,24 @@ def _run_stages(args, on, gated, py) -> None:
     # "highest" itself — BASELINE.md:60-63's promised rerun). The torch
     # side runs on host CPU; --only jax reuses the recorded torch curve.
     if on("parity-tpu"):
+        # --steps MUST match the recorded torch curve (1500): a shorter
+        # partial rerun overwrites the jax record and the final-loss delta
+        # becomes meaningless (the script now also guards this itself).
         gated(
             "parity-tpu",
             [py, os.path.join(REPO, "scripts", "parity_experiment.py"),
-             "--steps", "300", "--only", "jax"],
+             "--steps", "1500", "--only", "jax"],
             3600,
+        )
+
+    # 7b. End-to-end operational exercise on the real chip: real-corpus
+    # train -> SIGTERM preemption -> resume -> evaluate, through the CLIs
+    # (VERDICT r2 #3's "real on-chip training run").
+    if on("e2e"):
+        gated(
+            "e2e",
+            [py, os.path.join(REPO, "scripts", "tpu_e2e.py"), "--steps", "300"],
+            1800,
         )
 
     # 8. The rest of the grid.

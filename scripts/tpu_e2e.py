@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""End-to-end real-corpus training exercise on live hardware.
+
+Drives the full operational story the unit suite can only simulate on the
+virtual CPU mesh — on the real chip, through the real CLIs:
+
+  1. `scripts/train.py` pretrains a small byte-level model on REAL text
+     (the parity harness's harvested-prose corpus, data/parity/train.bin),
+     checkpointing on an interval.
+  2. Mid-run the harness delivers SIGTERM (cloud-preemption shape); the
+     trainer must save a preemption checkpoint at the next log boundary and
+     exit cleanly (trainer.py preemption path, VERDICT r2 #3).
+  3. A second `scripts/train.py` invocation RESUMES from that checkpoint
+     (same command line — resume is the default) and trains to completion.
+  4. `scripts/evaluate.py` loads the final checkpoint and reports val loss.
+
+Emits ONE JSON line: preemption step, resume step, final/eval losses, and
+pass/fail checks (resumed from the preemption checkpoint; loss fell vs
+init ln(256); eval loss finite and sane). Exit 0 iff every check passes.
+
+Usage:  python scripts/tpu_e2e.py [--steps 300] [--out-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PARITY = os.path.join(REPO, "data", "parity")
+
+
+def wait_for_step(metrics_path: str, step: int, timeout: float) -> bool:
+    """Poll the run's metrics JSONL until a `step >= step` record lands."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if os.path.exists(metrics_path):
+            try:
+                with open(metrics_path) as f:
+                    for ln in f:
+                        rec = json.loads(ln)
+                        if rec.get("step", -1) >= step and "loss" in rec:
+                            return True
+            except (json.JSONDecodeError, OSError):
+                pass  # mid-write line; retry
+        time.sleep(0.5)
+    return False
+
+
+def read_metrics(metrics_path: str) -> list:
+    out = []
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            for ln in f:
+                try:
+                    out.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300,
+                    help="steps the RESUMED run trains past the preemption "
+                    "checkpoint (phase 2 total = preempted_step + steps)")
+    ap.add_argument("--preempt-at", type=int, default=0,
+                    help="send SIGTERM once this step is logged (0 = 50)")
+    ap.add_argument("--out-dir", default="",
+                    help="work dir for checkpoints/metrics (default: tmp)")
+    ap.add_argument("--phase-timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    train_bin = os.path.join(PARITY, "train.bin")
+    val_bin = os.path.join(PARITY, "val.bin")
+    if not os.path.exists(train_bin):
+        print(json.dumps({"error": f"no real-text corpus at {train_bin}; run "
+                          "scripts/parity_experiment.py once to build it"}))
+        return 1
+
+    work = args.out_dir or tempfile.mkdtemp(prefix="tpu_e2e_")
+    os.makedirs(work, exist_ok=True)
+    ckpt_dir = os.path.join(work, "ckpt")
+    metrics = os.path.join(work, "metrics.jsonl")
+    preempt_at = args.preempt_at or 50
+
+    # Byte-level model sized to make this a real (but fast) TPU run: the
+    # corpus is uint16 byte tokens, vocab 256. Checkpoint every 50 so the
+    # preemption save and the interval save both get exercised.
+    def train_cmd(steps: int) -> list:
+        return [
+            sys.executable, os.path.join(REPO, "scripts", "train.py"),
+            "--preset", "tiny",
+            "--steps", str(steps),
+            "--override",
+            "model.d_model=256", "model.n_layers=4", "model.n_heads=8",
+            "model.context_length=256",
+            f"data.train_path={train_bin}", f"data.val_path={val_bin}",
+            f"train.train_steps={steps}",
+            "train.batch_size=16", "train.checkpoint_interval=50",
+            "train.eval_interval=0", "train.log_interval=10",
+            "train.lr=1e-3", "train.seed=7",
+            f"train.checkpoint_dir={ckpt_dir}",
+            f"train.metrics_path={metrics}",
+        ]
+
+    result: dict = {"preempt_at": preempt_at, "work": work}
+
+    # --- Phase 1: train until preempt_at, then SIGTERM -----------------
+    # Phase 1's step budget is effectively unbounded: on a fast backend the
+    # whole nominal run can finish between two 0.5s metric polls, which
+    # would make every preemption check spuriously fail. With a huge budget
+    # SIGTERM always lands mid-run; phase 2's target is computed from the
+    # step the preemption checkpoint actually recorded.
+    err1 = open(os.path.join(work, "phase1.stderr"), "w")
+    p1 = subprocess.Popen(train_cmd(1_000_000), stdout=err1,
+                          stderr=subprocess.STDOUT, cwd=REPO)
+    try:
+        if not wait_for_step(metrics, preempt_at, args.phase_timeout):
+            p1.kill()
+            print(json.dumps({**result, "error":
+                              f"phase1: step {preempt_at} never logged "
+                              f"(see {work}/phase1.stderr)"}))
+            return 1
+        p1.send_signal(signal.SIGTERM)
+        rc1 = p1.wait(timeout=args.phase_timeout)
+    except subprocess.TimeoutExpired:
+        p1.kill()
+        print(json.dumps({**result, "error": "phase1: hung after SIGTERM"}))
+        return 1
+    finally:
+        err1.close()
+    recs = read_metrics(metrics)
+    preempt_recs = [r for r in recs if r.get("event") == "preempted"]
+    ckpts = sorted(
+        int(d.split("-")[1]) for d in os.listdir(ckpt_dir)
+        if d.startswith("step-")) if os.path.isdir(ckpt_dir) else []
+    result.update({
+        "phase1_rc": rc1,
+        "preempted_step": preempt_recs[-1]["step"] if preempt_recs else None,
+        "ckpts_after_preempt": ckpts,
+    })
+    if result["preempted_step"] is None:
+        print(json.dumps({**result, "error":
+                          "phase1: no preemption event recorded "
+                          f"(see {work}/phase1.stderr)"}))
+        return 1
+
+    # --- Phase 2: resume from the preemption checkpoint and finish -----
+    total_steps = result["preempted_step"] + args.steps
+    result["total_steps"] = total_steps
+    try:
+        with open(os.path.join(work, "phase2.stderr"), "w") as err2:
+            rc2 = subprocess.run(train_cmd(total_steps), stdout=err2,
+                                 stderr=subprocess.STDOUT, cwd=REPO,
+                                 timeout=args.phase_timeout).returncode
+    except subprocess.TimeoutExpired:
+        print(json.dumps({**result, "error": "phase2: resume run hung"}))
+        return 1
+    recs = read_metrics(metrics)
+    resume_recs = [r for r in recs if r.get("event") == "resumed"]
+    step_losses = [r for r in recs if "loss" in r and "step" in r]
+    final_ckpts = sorted(
+        int(d.split("-")[1]) for d in os.listdir(ckpt_dir)
+        if d.startswith("step-")) if os.path.isdir(ckpt_dir) else []
+    result.update({
+        "phase2_rc": rc2,
+        "resumed_from": resume_recs[-1].get("step") if resume_recs else None,
+        "final_step": step_losses[-1]["step"] if step_losses else None,
+        "first_loss": step_losses[0]["loss"] if step_losses else None,
+        "final_loss": step_losses[-1]["loss"] if step_losses else None,
+        "ckpts_final": final_ckpts,
+    })
+
+    # --- Phase 3: standalone evaluation of the final checkpoint --------
+    try:
+        ev = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "evaluate.py"),
+             "--model_path", ckpt_dir, "--data", val_bin, "--iters", "20"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
+            timeout=args.phase_timeout, text=True)
+        eval_lines = [ln for ln in ev.stdout.splitlines() if ln.strip()]
+        eval_rec = {}
+        for ln in reversed(eval_lines):
+            try:
+                eval_rec = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+        result["eval"] = eval_rec
+    except subprocess.TimeoutExpired:
+        print(json.dumps({**result, "error": "phase3: evaluate hung"}))
+        return 1
+
+    # --- Checks --------------------------------------------------------
+    import math
+    eval_loss = result.get("eval", {}).get("val_loss")
+    checks = {
+        "phase1_clean_exit": rc1 == 0,
+        "preemption_checkpoint_saved": (
+            result["preempted_step"] is not None
+            and result["preempted_step"] in result["ckpts_after_preempt"]),
+        "resumed_from_preemption": (
+            result["resumed_from"] == result["preempted_step"]),
+        "ran_to_completion": result["final_step"] == total_steps and rc2 == 0,
+        "loss_fell": (
+            result["final_loss"] is not None
+            and result["final_loss"] < math.log(256.0) - 1.0),
+        "eval_sane": (
+            isinstance(eval_loss, (int, float))
+            and eval_loss == eval_loss and eval_loss < math.log(256.0)),
+    }
+    result["checks"] = checks
+    result["ok"] = all(checks.values())
+    if not args.out_dir and result["ok"]:
+        shutil.rmtree(work, ignore_errors=True)
+        result["work"] = ""
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
