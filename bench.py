@@ -364,8 +364,9 @@ def run_bench(args: argparse.Namespace) -> dict:
     batch = args.batch or cfg.train.batch_size
     if args.batch == 0 and args.preset == "gpt2-124m":
         # Driver default run: the measured-best batch for this chip, not the
-        # preset's training default.
-        batch = 24
+        # preset's training default (v5e sweep 2026-07-31: b16 41.6% MFU >
+        # b24 40.6% > b32 40.1% at save_attn/chunked).
+        batch = 16
     if args.quick:
         args.steps, args.warmup, batch = 5, 2, min(batch, 4)
     cfg = cfg.replace(model=model, train=dataclasses.replace(cfg.train, batch_size=batch))
@@ -583,13 +584,14 @@ def wrapper_main(args: argparse.Namespace) -> int:
         and args.preset == "gpt2-124m"
     )
     if race:
-        # (remat, attention) candidates, newest policy first. The tail is
-        # the KNOWN-GOOD ladder (VERDICT r2 next #1c): 'full' remat + flash
-        # is the round-1-measured-safe config, and naive attention last —
-        # a Mosaic pathology in the new policies can cost bounded attempts,
-        # never the round's number.
+        # (remat, attention) candidates, measured-best first (v5e on-chip
+        # sweep 2026-07-31: save_attn > save_qkv_attn > save_big at every
+        # batch). The tail is the KNOWN-GOOD ladder (VERDICT r2 next #1c):
+        # 'full' remat + flash is the round-1-measured-safe config, and
+        # naive attention last — a pathology in any one policy can cost
+        # bounded attempts, never the round's number.
         candidates = [
-            ("save_big", ""), ("save_attn", ""), ("full", ""), ("full", "naive"),
+            ("save_attn", ""), ("save_big", ""), ("full", ""), ("full", "naive"),
         ]
     else:
         candidates = [(args.remat, "")]

@@ -696,21 +696,83 @@ def _chunked_ce(
             )
     xs = hidden.reshape(n_chunks, s // n_chunks, d)
     ts_ = targets.reshape(n_chunks, s // n_chunks)
+    return _lse_saved_ce(xs, w_out, bias, ts_, cdt) / s
 
-    def chunk(carry, inp):
-        xc, tc = inp
+
+def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
+    """Sum of per-token CE over chunked logits, custom VJP.
+
+    vs `lax.scan(jax.checkpoint(chunk))`: the checkpointed backward re-runs
+    the whole forward per chunk — logits matmul, then max + exp + sum for
+    logsumexp, then ANOTHER exp for its VJP — four elementwise passes over
+    the (S, V) block that exists only to rebuild what one saved (S,) vector
+    already knows. Saving lse (4 bytes/token) lets the backward form
+    softmax = exp(logits - lse) in ONE pass after the (unavoidable) logits
+    matmul recompute. Matmul count and the fp32 dW scan carry are identical
+    to the autodiff version — this strictly removes VPU reduction passes.
+
+    Gradients match the checkpointed path to float-associativity: dlogits
+    stays fp32 into the dX/dW matmuls exactly as autodiff would keep it.
+    """
+    sc = ts_.shape[1]
+
+    def logits_of(xc, wc, bias):
         logits = jnp.einsum(
-            "sd,dv->sv", xc.astype(cdt), w_out.astype(cdt),
-            preferred_element_type=jnp.float32,
+            "sd,dv->sv", xc.astype(cdt), wc, preferred_element_type=jnp.float32
         )
         if bias is not None:
             logits = logits + bias.astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        label_logit = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-        return carry + jnp.sum(logz - label_logit), None
+        return logits
 
-    total, _ = jax.lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32), (xs, ts_))
-    return total / s
+    @jax.custom_vjp
+    def ce(xs, w_out, bias):
+        return _fwd(xs, w_out, bias)[0]
+
+    def _fwd(xs, w_out, bias):
+        wc = w_out.astype(cdt)
+
+        def chunk(carry, inp):
+            xc, tc = inp
+            logits = logits_of(xc, wc, bias)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            label_logit = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+            return carry + jnp.sum(lse - label_logit), lse
+
+        total, lses = jax.lax.scan(chunk, jnp.zeros((), jnp.float32), (xs, ts_))
+        return total, (xs, w_out, bias, lses)
+
+    def _bwd(res, g):
+        xs, w_out, bias, lses = res
+        wc = w_out.astype(cdt)
+        dw0 = jnp.zeros(w_out.shape, jnp.float32)
+        db0 = None if bias is None else jnp.zeros(bias.shape, jnp.float32)
+
+        def chunk(carry, inp):
+            dw_acc, db_acc = carry
+            xc, tc, lse = inp
+            logits = logits_of(xc, wc, bias)
+            p = jnp.exp(logits - lse[:, None])  # softmax, one pass
+            dlogits = (p.at[jnp.arange(sc), tc].add(-1.0)) * g  # fp32
+            dx = jnp.einsum(
+                "sv,dv->sd", dlogits, wc, preferred_element_type=jnp.float32
+            )
+            dw_acc = dw_acc + jnp.einsum(
+                "sd,sv->dv", xc.astype(cdt), dlogits,
+                preferred_element_type=jnp.float32,
+            )
+            if db_acc is not None:
+                db_acc = db_acc + jnp.sum(dlogits, axis=0)
+            return (dw_acc, db_acc), dx.astype(xs.dtype)
+
+        (dw, db), dxs = jax.lax.scan(chunk, (dw0, db0), (xs, ts_, lses))
+        return (
+            dxs,
+            dw.astype(w_out.dtype),
+            None if bias is None else db.astype(bias.dtype),
+        )
+
+    ce.defvjp(_fwd, _bwd)
+    return ce(xs, w_out, bias)
 
 
 def loss_fn(

@@ -125,11 +125,24 @@ def run_cmd(name: str, cmd: list, timeout: float, out_f,
     t0 = time.time()  # after the gate: wall_s is pure stage runtime
     print(f"[capture] {name}: {' '.join(cmd[1:])}", flush=True)
     try:
-        proc = subprocess.run(
-            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout,
-            text=True, cwd=REPO,
+        # start_new_session + killpg: a timed-out stage must take its WHOLE
+        # process tree down. Stages are wrappers around wrappers (tpu_e2e ->
+        # train.py, bench.py -> inner attempt); killing only the top process
+        # orphans a grandchild that may be holding (or wedging) the chip —
+        # the exact cascade the canary gates exist to stop.
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+            text=True, cwd=REPO, start_new_session=True,
         )
-        lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+        try:
+            stdout, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            import signal as _signal
+
+            os.killpg(proc.pid, _signal.SIGKILL)
+            proc.wait()
+            raise
+        lines = [ln for ln in (stdout or "").splitlines() if ln.strip()]
         try:
             payload = json.loads(lines[-1]) if lines else {}
         except json.JSONDecodeError:
@@ -297,7 +310,7 @@ def _run_stages(args, on, gated, py) -> None:
         gated(
             "profile",
             [py, os.path.join(REPO, "scripts", "profile_capture.py"),
-             "--preset", "gpt2-124m", "--batch", "24",
+             "--preset", "gpt2-124m", "--batch", "16",
              "--remat", "save_attn", "--top", "40"],
             900,
         )

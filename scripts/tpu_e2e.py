@@ -124,7 +124,6 @@ def main() -> int:
                           stderr=subprocess.STDOUT, cwd=REPO)
     try:
         if not wait_for_step(metrics, preempt_at, args.phase_timeout):
-            p1.kill()
             print(json.dumps({**result, "error":
                               f"phase1: step {preempt_at} never logged "
                               f"(see {work}/phase1.stderr)"}))
@@ -132,10 +131,15 @@ def main() -> int:
         p1.send_signal(signal.SIGTERM)
         rc1 = p1.wait(timeout=args.phase_timeout)
     except subprocess.TimeoutExpired:
-        p1.kill()
         print(json.dumps({**result, "error": "phase1: hung after SIGTERM"}))
         return 1
     finally:
+        # The unbounded-step child must NEVER outlive this harness — an
+        # orphan would hold the chip indefinitely. Covers every exit path
+        # (including an outer SIGTERM raising through the waits above).
+        if p1.poll() is None:
+            p1.kill()
+            p1.wait()
         err1.close()
     recs = read_metrics(metrics)
     preempt_recs = [r for r in recs if r.get("event") == "preempted"]

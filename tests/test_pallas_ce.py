@@ -193,3 +193,42 @@ def test_model_fused_on_data_sharded_mesh_matches_single_device():
         ),
         g_single, g_mesh,
     )
+
+
+# ---------------------------------------------------------------------------
+# The lse-saved chunked head (the default ce_impl="chunked" backward)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_lse_saved_chunked_matches_dense(with_bias):
+    """_lse_saved_ce (custom VJP saving per-token lse) == whole-logits CE,
+    loss AND all gradients, with and without an lm_head bias."""
+    from pretraining_llm_tpu.models.transformer import _lse_saved_ce
+
+    s, d, v, chunks = 64, 32, 160, 4
+    h, w, labels = _inputs(jax.random.key(7), s=s, d=d, v=v)
+    bias = (jax.random.normal(jax.random.key(8), (v,)) * 0.2) if with_bias else None
+
+    def dense(h, w, bias):
+        logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+        return jnp.sum(lse - gold)
+
+    def chunked(h, w, bias):
+        xs = h.reshape(chunks, s // chunks, d)
+        ts = labels.reshape(chunks, s // chunks)
+        return _lse_saved_ce(xs, w, bias, ts, jnp.float32)
+
+    argnums = (0, 1, 2) if with_bias else (0, 1)
+    l_ref, g_ref = jax.value_and_grad(dense, argnums=argnums)(h, w, bias)
+    l_new, g_new = jax.value_and_grad(chunked, argnums=argnums)(h, w, bias)
+    np.testing.assert_allclose(float(l_new), float(l_ref), rtol=1e-5)
+    for a, b in zip(g_ref, g_new):
+        np.testing.assert_allclose(
+            np.asarray(b).reshape(np.asarray(a).shape), np.asarray(a),
+            rtol=2e-4, atol=2e-5,
+        )
