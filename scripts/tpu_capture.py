@@ -136,11 +136,16 @@ def run_cmd(name: str, cmd: list, timeout: float, out_f,
         )
         try:
             stdout, _ = proc.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
+        except BaseException:
+            # ANY abnormal exit from the wait (stage timeout, Ctrl-C, a
+            # campaign kill) must take the detached stage tree down with it
+            # — start_new_session means nobody else will signal it, and an
+            # orphaned stage keeps holding (or wedging) the chip.
             import signal as _signal
 
-            os.killpg(proc.pid, _signal.SIGKILL)
-            proc.wait()
+            if proc.poll() is None:
+                os.killpg(proc.pid, _signal.SIGKILL)
+                proc.wait()
             raise
         lines = [ln for ln in (stdout or "").splitlines() if ln.strip()]
         try:
@@ -171,6 +176,7 @@ def main() -> int:
     KNOWN = {
         "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
         "sweep-full", "sweep2", "profile", "e2e", "batch-sweep",
+        "unroll-sweep",
     }
     want = None
     if args.stages:
@@ -301,6 +307,18 @@ def _run_stages(args, on, gated, py) -> None:
                 "bsweep:" + "/".join(extra).replace("--", ""),
                 [py, BENCH, "--skip-canary", "--remat", "save_attn",
                  "--timeout-budget", "700"] + extra,
+                820,
+            )
+
+    # 3b3. Layer-scan unroll at the winning config: unrolling trades
+    # compile time + code size for cross-layer scheduling freedom.
+    if on("unroll-sweep"):
+        for unroll in (2, 4):
+            gated(
+                f"unroll:{unroll}",
+                [py, BENCH, "--skip-canary", "--remat", "save_attn",
+                 "--batch", "16", "--unroll", str(unroll),
+                 "--timeout-budget", "700"],
                 820,
             )
 

@@ -88,12 +88,12 @@ def test_hang_with_live_canary_moves_to_next_candidate(monkeypatch, capsys):
     # the problem; candidate 2 succeeds and is reported.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.41, "save_attn")],
+        attempts_script=[HUNG, _ok(0.41, "save_big")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
-    assert [r for r, _ in calls["attempts"]] == ["save_big", "save_attn"]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
     assert calls["canaries"] == 1  # exactly one cheap probe after the hang
 
 
@@ -121,14 +121,14 @@ def test_wedged_then_recovered_retries_same_candidate(monkeypatch, capsys):
     # min(attempt_timeout, share), so share > 2*attempt_timeout + polls.)
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.40, "save_big"), _ok(0.38, "save_attn")],
+        attempts_script=[HUNG, _ok(0.40, "save_attn"), _ok(0.38, "save_big")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
         args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.40  # best of the race, from the retried candidate
     assert [r for r, _ in calls["attempts"]] == [
-        "save_big", "save_big", "save_attn"]
+        "save_attn", "save_attn", "save_big"]
 
 
 def test_double_hang_abandons_candidate(monkeypatch, capsys):
@@ -137,14 +137,14 @@ def test_double_hang_abandons_candidate(monkeypatch, capsys):
     # time.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, HUNG, _ok(0.39, "save_attn")],
+        attempts_script=[HUNG, HUNG, _ok(0.39, "save_big")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
         args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.39
     assert [r for r, _ in calls["attempts"]] == [
-        "save_big", "save_big", "save_attn"]
+        "save_attn", "save_attn", "save_big"]
 
 
 def test_wedge_with_banked_result_reports_it_immediately(monkeypatch, capsys):
@@ -167,12 +167,12 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
     # tail is never run (budget preserved).
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.30, "save_big"), _ok(0.41, "save_attn")],
+        attempts_script=[_ok(0.41, "save_attn"), _ok(0.30, "save_big")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
-    assert [r for r, _ in calls["attempts"]] == ["save_big", "save_attn"]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
 
 
 def test_structured_inner_error_is_relayed(monkeypatch, capsys):
