@@ -181,7 +181,11 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         batch = min(batch, 4)
     new_tokens = min(64 if args.quick else 256, cfg.context_length // 2)
     prompt_len = min(64, cfg.context_length - new_tokens)
-    params = transformer.init_params(cfg, jax.random.key(0))
+    from pretraining_llm_tpu.generation.generate import cast_params_for_inference
+
+    params = cast_params_for_inference(
+        transformer.init_params(cfg, jax.random.key(0)), cfg
+    )
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )

@@ -31,6 +31,41 @@ from pretraining_llm_tpu.models import transformer
 from pretraining_llm_tpu.generation.sampling import sample_logits
 
 
+def cast_params_for_inference(params: Any, cfg: ModelConfig) -> Any:
+    """One-time fp32 -> compute-dtype cast of the matmul weights.
+
+    Explicit serving-prep step (like `shard_params_for_inference`): call it
+    once after checkpoint load and drop the fp32 tree. The forward casts
+    every matmul weight to `compute_dtype` at its use site; fp32 params
+    flowing into the decode scan therefore read 2x the bytes per step
+    (fp32 source) unless XLA's loop-invariant code motion happens to hoist
+    the converts — which it must trade against the extra live copy, so it
+    is not guaranteed. Pre-casting makes the per-step weight traffic the
+    bf16 minimum and (once the caller drops the fp32 tree) halves param
+    HBM, with BIT-IDENTICAL results: the same cast happens at every use
+    site anyway. Leaves the forward deliberately consumes in fp32 are NOT
+    cast — norm scales/biases (fp32 norm math, layers.layernorm/rmsnorm),
+    the lm_head bias (added to fp32 logits, transformer.py:585), and the
+    MoE router (fp32 routing scores, moe.py) — casting those would change
+    numerics.
+    """
+    cdt = jnp.dtype(cfg.compute_dtype)
+
+    def cast(path, x):
+        if not jnp.issubdtype(x.dtype, jnp.floating) or x.dtype == cdt:
+            return x
+        names = [str(getattr(k, "key", "")) for k in path]
+        if any(n.startswith("ln") or "norm" in n for n in names):
+            return x
+        if names[-1] == "router":
+            return x
+        if len(names) >= 2 and names[-2] == "lm_head" and names[-1] == "bias":
+            return x
+        return x.astype(cdt)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
 def _bucket_len(prompt_len: int, ctx: int, max_new_tokens: int) -> int:
     """Pad target for the prompt: next power of two (>=16), capped so the
     padded prompt + generation still fits the context. Prompt LENGTH is a
@@ -265,7 +300,11 @@ def load_model_for_inference(model_path: str) -> Tuple[Any, Config]:
         lambda: {"params": transformer.init_params(cfg.model, jax.random.key(0))}
     )
     restored, _ = ckpt.load_checkpoint(path, template)
-    return jax.device_put(restored["params"]), cfg
+    # Serving prep: bf16 matmul weights (bit-identical forward — see
+    # cast_params_for_inference); the fp32 tree is dropped here, halving
+    # param HBM for the generation CLIs.
+    params = cast_params_for_inference(restored["params"], cfg.model)
+    return jax.device_put(params), cfg
 
 
 def generate_text(

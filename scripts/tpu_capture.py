@@ -143,9 +143,14 @@ def run_cmd(name: str, cmd: list, timeout: float, out_f,
             # orphaned stage keeps holding (or wedging) the chip.
             import signal as _signal
 
-            if proc.poll() is None:
+            # Unconditional: the group can hold live grandchildren even
+            # after the leader exited (they inherit the stdout pipe, so
+            # communicate() was still blocked on them).
+            try:
                 os.killpg(proc.pid, _signal.SIGKILL)
-                proc.wait()
+            except ProcessLookupError:
+                pass
+            proc.wait()
             raise
         lines = [ln for ln in (stdout or "").splitlines() if ln.strip()]
         try:

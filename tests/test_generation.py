@@ -476,3 +476,53 @@ def test_chunked_prefill_with_traced_offset_matches_full_forward():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(full), rtol=2e-4, atol=2e-4
     )
+
+
+@pytest.mark.parametrize(
+    "variant", ["plain", "biased_head", "moe"]
+)
+def test_cast_params_for_inference_bit_identical(variant):
+    """Pre-casting matmul weights to compute dtype is bit-identical (the
+    forward casts at every use site anyway) and leaves the fp32-consumed
+    leaves alone: norm params, the lm_head bias, the MoE router."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+    from jax.tree_util import tree_flatten_with_path
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.generation.generate import (
+        cast_params_for_inference, generate,
+    )
+    from pretraining_llm_tpu.models import transformer
+
+    cfg = get_preset("tiny").model
+    if variant == "biased_head":
+        cfg = dc.replace(cfg, tie_embeddings=False, lm_head_bias=True)
+    elif variant == "moe":
+        cfg = dc.replace(cfg, n_experts=4, experts_per_token=2)
+    p = transformer.init_params(cfg, jax.random.key(0))
+    pc = cast_params_for_inference(p, cfg)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    for path, leaf in tree_flatten_with_path(pc)[0]:
+        names = [str(getattr(k, "key", "")) for k in path]
+        fp32_consumed = (
+            any(n.startswith("ln") or "norm" in n for n in names)
+            or names[-1] == "router"
+            or (len(names) >= 2 and names[-2] == "lm_head" and names[-1] == "bias")
+        )
+        if fp32_consumed:
+            assert leaf.dtype == jnp.float32, names
+        elif jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == cdt, names
+
+    x = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    l1, l2 = transformer.forward(p, x, cfg), transformer.forward(pc, x, cfg)
+    if isinstance(l1, tuple):
+        l1, l2 = l1[0], l2[0]
+    assert bool(jnp.all(l1 == l2))
+    if variant != "moe":  # ragged-free dense decode path
+        g1 = generate(p, cfg, x, 8, jax.random.key(2), temperature=0.0)
+        g2 = generate(pc, cfg, x, 8, jax.random.key(2), temperature=0.0)
+        assert bool(jnp.all(g1 == g2))
