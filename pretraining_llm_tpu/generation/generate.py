@@ -300,11 +300,11 @@ def load_model_for_inference(model_path: str) -> Tuple[Any, Config]:
         lambda: {"params": transformer.init_params(cfg.model, jax.random.key(0))}
     )
     restored, _ = ckpt.load_checkpoint(path, template)
-    # Serving prep: bf16 matmul weights (bit-identical forward — see
-    # cast_params_for_inference); the fp32 tree is dropped here, halving
-    # param HBM for the generation CLIs.
-    params = cast_params_for_inference(restored["params"], cfg.model)
-    return jax.device_put(params), cfg
+    # NOTE: returns the RAW checkpoint dtypes — callers that only run the
+    # forward should apply cast_params_for_inference (the generation CLIs
+    # below do); callers that re-export weights (export_torch_checkpoint)
+    # need the fp32 masters untouched.
+    return jax.device_put(restored["params"]), cfg
 
 
 def generate_text(
@@ -359,6 +359,10 @@ def generate_text_batch(
     if not input_texts:
         raise ValueError("input_texts is empty (nothing to generate)")
     params, cfg = load_model_for_inference(model_path)
+    # Serving prep: bf16 matmul weights (bit-identical forward — see
+    # cast_params_for_inference); the fp32 tree is dropped here, halving
+    # param HBM and the per-step weight reads for the generation CLIs.
+    params = cast_params_for_inference(params, cfg.model)
     enc = get_tokenizer(tokenizer or cfg.data.tokenizer_name)
     encoded = [
         np.asarray(enc.encode_ordinary(t), np.int32) for t in input_texts

@@ -345,6 +345,11 @@ def _run_stages(args, on, gated, py) -> None:
             "decode-ragged",
             [py, BENCH, "--skip-canary", "--mode", "decode", "--ragged"], 900,
         )
+        gated(
+            "decode-int8",
+            [py, BENCH, "--skip-canary", "--mode", "decode",
+             "--kv-dtype", "int8"], 900,
+        )
 
     # 5. 8k context on one chip (flash; the SP mesh needs multi-chip).
     if on("ctx8k"):

@@ -503,19 +503,29 @@ def test_cast_params_for_inference_bit_identical(variant):
     elif variant == "moe":
         cfg = dc.replace(cfg, n_experts=4, experts_per_token=2)
     p = transformer.init_params(cfg, jax.random.key(0))
+    # Zero-initialized leaves (lm_head bias, norm biases) would make the
+    # forward comparison vacuous (0.0 rounds exactly to bf16): randomize
+    # EVERY float leaf so a wrongly-cast leaf actually changes the logits.
+    leaves, treedef = jax.tree_util.tree_flatten(p)
+    keys = jax.random.split(jax.random.key(99), len(leaves))
+    p = jax.tree_util.tree_unflatten(treedef, [
+        (jax.random.normal(k, l.shape, jnp.float32) * 0.05).astype(l.dtype)
+        if jnp.issubdtype(l.dtype, jnp.floating) else l
+        for k, l in zip(keys, leaves)
+    ])
     pc = cast_params_for_inference(p, cfg)
     cdt = jnp.dtype(cfg.compute_dtype)
+    # Hand-listed fp32-consumed leaf names (independent of the
+    # implementation's path predicate).
+    fp32_expected = {"ln1/scale", "ln1/bias", "ln2/scale", "ln2/bias",
+                     "final_norm/scale", "final_norm/bias", "lm_head/bias"}
+    fp32_suffixes = tuple(fp32_expected) + ("router",)
     for path, leaf in tree_flatten_with_path(pc)[0]:
-        names = [str(getattr(k, "key", "")) for k in path]
-        fp32_consumed = (
-            any(n.startswith("ln") or "norm" in n for n in names)
-            or names[-1] == "router"
-            or (len(names) >= 2 and names[-2] == "lm_head" and names[-1] == "bias")
-        )
-        if fp32_consumed:
-            assert leaf.dtype == jnp.float32, names
+        name = "/".join(str(getattr(k, "key", "")) for k in path)
+        if name.endswith(fp32_suffixes):
+            assert leaf.dtype == jnp.float32, name
         elif jnp.issubdtype(leaf.dtype, jnp.floating):
-            assert leaf.dtype == cdt, names
+            assert leaf.dtype == cdt, name
 
     x = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
     l1, l2 = transformer.forward(p, x, cfg), transformer.forward(pc, x, cfg)
