@@ -272,7 +272,7 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
         model = dc.replace(
             model, flash_block_q=args.block_q, flash_block_kv=args.block_kv
         )
-    batch = args.batch or (24 if args.preset == "gpt2-124m" else cfg.train.batch_size)
+    batch = args.batch or (16 if args.preset == "gpt2-124m" else cfg.train.batch_size)
     steps = 8 if args.quick else max(args.steps, 10)
     if args.quick:
         batch = min(batch, 4)
