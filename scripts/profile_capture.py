@@ -33,6 +33,11 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=24)
     ap.add_argument("--remat", default="")
     ap.add_argument("--attention", default="")
+    ap.add_argument(
+        "--mode", default="train", choices=["train", "decode"],
+        help="decode: trace KV-cached generation (prefill + token scan) "
+        "instead of the train step — the ground truth for serving opt",
+    )
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--out", default="/tmp/pllm_trace")
     ap.add_argument("--tool", default="hlo_stats")
@@ -59,18 +64,49 @@ def main() -> None:
         cfg = cfg.replace(
             model=model, train=dataclasses.replace(cfg.train, batch_size=args.batch)
         )
-        state = ts.init_train_state(cfg, jax.random.key(0))
-        step = ts.build_train_step(cfg, None)
-        it = loader.synthetic_iterator(model.vocab_size, model.context_length, args.batch, seed=0)
-        x, y = next(it)
-        batch = (jnp.asarray(x), jnp.asarray(y))
-        # Warm (compile) outside the trace window.
-        state, m = step(state, batch)
-        float(jax.device_get(m["loss"]))
-        with jax.profiler.trace(args.out):
-            for _ in range(args.steps):
-                state, m = step(state, batch)
+        if args.mode == "decode":
+            from pretraining_llm_tpu.generation.generate import (
+                cast_params_for_inference, generate,
+            )
+            from pretraining_llm_tpu.models import transformer as _tf
+
+            mcfg = model
+            if mcfg.attention_impl in ("ring", "ulysses"):
+                mcfg = dataclasses.replace(
+                    mcfg, attention_impl="naive", sequence_parallel=False
+                )
+            params = cast_params_for_inference(
+                _tf.init_params(mcfg, jax.random.key(0)), mcfg
+            )
+            new_tokens = min(256, mcfg.context_length // 2)
+            prompt_len = min(64, mcfg.context_length - new_tokens)
+            prompt = jax.random.randint(
+                jax.random.key(1), (args.batch, prompt_len), 0, mcfg.vocab_size
+            )
+
+            def run(seed):
+                return jax.device_get(
+                    generate(params, mcfg, prompt, new_tokens,
+                             jax.random.key(seed), temperature=1.0)
+                )
+
+            run(0)  # compile + warm outside the trace window
+            with jax.profiler.trace(args.out):
+                for s in range(1, args.steps + 1):
+                    run(s)
+        else:
+            state = ts.init_train_state(cfg, jax.random.key(0))
+            step = ts.build_train_step(cfg, None)
+            it = loader.synthetic_iterator(model.vocab_size, model.context_length, args.batch, seed=0)
+            x, y = next(it)
+            batch = (jnp.asarray(x), jnp.asarray(y))
+            # Warm (compile) outside the trace window.
+            state, m = step(state, batch)
             float(jax.device_get(m["loss"]))
+            with jax.profiler.trace(args.out):
+                for _ in range(args.steps):
+                    state, m = step(state, batch)
+                float(jax.device_get(m["loss"]))
 
     planes = sorted(
         glob.glob(os.path.join(args.out, "**", "*.xplane.pb"), recursive=True),

@@ -337,6 +337,16 @@ def _run_stages(args, on, gated, py) -> None:
              "--remat", "save_attn", "--top", "40"],
             900,
         )
+        # Serving-side ground truth: the decode step is ~7x off the weight-
+        # read memory bound (2.08 ms/step vs ~0.3 theoretical) — find out
+        # where those milliseconds go.
+        gated(
+            "profile-decode",
+            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
+             "--preset", "gpt2-124m", "--batch", "8", "--mode", "decode",
+             "--steps", "2", "--top", "40"],
+            900,
+        )
 
     # 4. Decode throughput: dense bucketed + ragged serving shape.
     if on("decode"):
