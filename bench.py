@@ -179,23 +179,17 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
     # be a silent no-op here, so reject it instead of mismeasuring.
     if args.attention:
         raise ValueError("--attention has no effect on the cached decode path")
-    if cfg.attention_impl in ("ring", "ulysses"):
-        cfg = dataclasses.replace(cfg, attention_impl="naive", sequence_parallel=False)
     if args.kv_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
     batch = args.batch or 8
     if args.quick:
         batch = min(batch, 4)
-    new_tokens = min(64 if args.quick else 256, cfg.context_length // 2)
-    prompt_len = min(64, cfg.context_length - new_tokens)
-    from pretraining_llm_tpu.generation.generate import cast_params_for_inference
+    from pretraining_llm_tpu.generation.generate import decode_bench_workload
 
-    params = cast_params_for_inference(
-        transformer.init_params(cfg, jax.random.key(0)), cfg
+    cfg, params, prompt, new_tokens = decode_bench_workload(
+        cfg, batch, quick=args.quick
     )
-    prompt = jax.random.randint(
-        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
-    )
+    prompt_len = int(prompt.shape[1])
     # --ragged: serving-shaped batch — per-row prompt lengths spread over
     # [prompt_len/4, prompt_len], decoded in the one lockstep ragged program.
     lengths = None

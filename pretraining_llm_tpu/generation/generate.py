@@ -66,6 +66,31 @@ def cast_params_for_inference(params: Any, cfg: ModelConfig) -> Any:
     return jax.tree_util.tree_map_with_path(cast, params)
 
 
+def decode_bench_workload(cfg: ModelConfig, batch: int, *,
+                          quick: bool = False) -> Tuple[ModelConfig, Any, jax.Array, int]:
+    """The canonical decode measurement workload, shared by `bench.py
+    --mode decode` and `profile_capture.py --mode decode` so the profile
+    always traces exactly the shape the benchmark measures.
+
+    Returns (cfg, params, prompt, new_tokens): ring/ulysses fall back to
+    the cached naive path, params are inference-cast, prompt is (batch,
+    prompt_len) with prompt_len = min(64, ctx - new_tokens).
+    """
+    import dataclasses as _dc
+
+    if cfg.attention_impl in ("ring", "ulysses"):
+        cfg = _dc.replace(cfg, attention_impl="naive", sequence_parallel=False)
+    new_tokens = min(64 if quick else 256, cfg.context_length // 2)
+    prompt_len = min(64, cfg.context_length - new_tokens)
+    params = cast_params_for_inference(
+        transformer.init_params(cfg, jax.random.key(0)), cfg
+    )
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    return cfg, params, prompt, new_tokens
+
+
 def _bucket_len(prompt_len: int, ctx: int, max_new_tokens: int) -> int:
     """Pad target for the prompt: next power of two (>=16), capped so the
     padded prompt + generation still fits the context. Prompt LENGTH is a

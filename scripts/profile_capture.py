@@ -65,23 +65,24 @@ def main() -> None:
             model=model, train=dataclasses.replace(cfg.train, batch_size=args.batch)
         )
         if args.mode == "decode":
-            from pretraining_llm_tpu.generation.generate import (
-                cast_params_for_inference, generate,
-            )
-            from pretraining_llm_tpu.models import transformer as _tf
-
-            mcfg = model
-            if mcfg.attention_impl in ("ring", "ulysses"):
-                mcfg = dataclasses.replace(
-                    mcfg, attention_impl="naive", sequence_parallel=False
+            # Same trap bench.py guards against (its --attention check):
+            # these flags shape the TRAIN step only; silently accepting
+            # them would produce identical traces labeled differently.
+            if args.remat or args.attention:
+                raise ValueError(
+                    "--remat/--attention have no effect on the cached "
+                    "decode path; drop them for --mode decode"
                 )
-            params = cast_params_for_inference(
-                _tf.init_params(mcfg, jax.random.key(0)), mcfg
+            from pretraining_llm_tpu.generation.generate import (
+                decode_bench_workload, generate,
             )
-            new_tokens = min(256, mcfg.context_length // 2)
-            prompt_len = min(64, mcfg.context_length - new_tokens)
-            prompt = jax.random.randint(
-                jax.random.key(1), (args.batch, prompt_len), 0, mcfg.vocab_size
+
+            # The canonical decode-bench workload from the RAW preset model
+            # (bench.py passes the raw model too — the train-oriented
+            # ring->flash rewrite above must not leak in): the trace
+            # explains exactly the shape `bench.py --mode decode` measures.
+            mcfg, params, prompt, new_tokens = decode_bench_workload(
+                get_preset(args.preset).model, args.batch
             )
 
             def run(seed):

@@ -340,11 +340,14 @@ def _run_stages(args, on, gated, py) -> None:
         # Serving-side ground truth: the decode step is ~7x off the weight-
         # read memory bound (2.08 ms/step vs ~0.3 theoretical) — find out
         # where those milliseconds go.
+        # Distinct --out: profile_capture parses the mtime-newest xplane
+        # under its out dir — sharing the train stage's dir would let a
+        # no-op decode trace silently print the TRAIN table as decode.
         gated(
             "profile-decode",
             [py, os.path.join(REPO, "scripts", "profile_capture.py"),
              "--preset", "gpt2-124m", "--batch", "8", "--mode", "decode",
-             "--steps", "2", "--top", "40"],
+             "--steps", "2", "--top", "40", "--out", "/tmp/pllm_trace_decode"],
             900,
         )
 
