@@ -181,7 +181,7 @@ def main() -> int:
     KNOWN = {
         "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
         "sweep-full", "sweep2", "profile", "e2e", "batch-sweep",
-        "unroll-sweep",
+        "unroll-sweep", "mfu-350m",
     }
     want = None
     if args.stages:
@@ -314,6 +314,24 @@ def _run_stages(args, on, gated, py) -> None:
                  "--timeout-budget", "700"] + extra,
                 820,
             )
+
+    # 3b2b. The other BASELINE model configs on the one chip: 350M
+    # (BASELINE config #2's model, mesh collapsed to 1 device) and the
+    # Llama-style 1B (config #4) at a batch its optimizer state + remat
+    # leave room for. OOM raises cleanly — it cannot wedge the chip.
+    if on("mfu-350m"):
+        for extra in ([], ["--batch", "16"]):
+            gated(
+                "mfu-350m" + ("/b16" if extra else ""),
+                [py, BENCH, "--skip-canary", "--preset", "gpt2-350m-dp",
+                 "--remat", "save_attn", "--timeout-budget", "800"] + extra,
+                920,
+            )
+    # (No single-chip 1B stage: fp32 params + Adam moments alone are
+    # ~14.9 GB of the chip's 16 GB — the 1B/1.3B configs are multi-chip
+    # FSDP targets; their sharded memory story is covered by
+    # `scripts/train.py --compile-only` AOT analysis and the virtual-mesh
+    # dryrun instead.)
 
     # 3b3. Layer-scan unroll at the winning config: unrolling trades
     # compile time + code size for cross-layer scheduling freedom.
