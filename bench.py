@@ -75,6 +75,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "(one lockstep ragged program)",
     )
     parser.add_argument(
+        "--optimizer", default="", choices=["", "adamw", "adafactor"],
+        help="train mode: optimizer override (adafactor's factored second "
+        "moments fit 1B+ configs on one chip)",
+    )
+    parser.add_argument(
         "--kv-dtype", default="", choices=["", "compute", "int8"],
         help="decode mode: KV-cache element type override (int8 = quantized "
         "persistent cache, ~1.9x smaller at Dh=64)",
@@ -369,6 +374,10 @@ def run_bench(args: argparse.Namespace) -> dict:
         # attention output spares the flash-forward rerun; saving more cuts
         # HBM traffic less than the recompute it avoids costs).
         model = dataclasses.replace(model, remat="save_attn")
+    if args.optimizer:
+        cfg = cfg.replace(
+            train=dataclasses.replace(cfg.train, optimizer=args.optimizer)
+        )
     batch = args.batch or cfg.train.batch_size
     if args.batch == 0 and args.preset == "gpt2-124m":
         # Driver default run: the measured-best batch for this chip, not the
@@ -525,6 +534,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--ce", args.ce]
     if remat:
         cmd += ["--remat", remat]
+    if args.optimizer:
+        cmd += ["--optimizer", args.optimizer]
     if args.unroll:
         cmd += ["--unroll", str(args.unroll)]
     if args.block_q:

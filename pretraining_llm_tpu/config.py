@@ -371,6 +371,10 @@ class TrainConfig:
     eval_iters: int = 250
     lr: float = 3e-4
     lr_schedule: str = "warmup_cosine"  # reference: 10% warmup then constant
+    # "adamw" (reference behavior) or "adafactor" (factored second moments,
+    # ~0.3 bytes/param optimizer state vs Adam's 8 — fits 1B+ models on one
+    # chip; see training/optimizer.py).
+    optimizer: str = "adamw"
     warmup_frac: float = 0.1
     min_lr_frac: float = 0.1  # cosine floor as a fraction of lr
     weight_decay: float = 0.1
@@ -402,6 +406,10 @@ class TrainConfig:
     def __post_init__(self) -> None:
         if self.lr_schedule not in _LR_SCHEDULES:
             raise ValueError(f"lr_schedule must be one of {_LR_SCHEDULES}")
+        if self.optimizer not in ("adamw", "adafactor"):
+            raise ValueError(
+                f"optimizer must be 'adamw' or 'adafactor', got {self.optimizer!r}"
+            )
         if self.batch_size % self.microbatches != 0:
             raise ValueError(
                 f"batch_size={self.batch_size} not divisible by microbatches={self.microbatches}"

@@ -118,6 +118,133 @@ def adamw_update(
     )
 
 
+# ---------------------------------------------------------------------------
+# Adafactor (memory-factored second moments)
+# ---------------------------------------------------------------------------
+#
+# Cuts optimizer state from 8 bytes/param (Adam mu+nu fp32) to ~0.3:
+# the second moment of an (r, c) matrix is stored as row/column statistics
+# R (r,) and C (c,) with V ~= R C^T / sum(R) (Shazeer & Stern 2018). No
+# first moment (beta1 = 0). This is what lets the Llama-style 1B train on
+# ONE 16 GB chip: fp32 params 4.96 GB + Adam moments 9.9 GB does not fit;
+# + factored state ~0.2 GB does. The reference has no optimizer choice at
+# all (torch AdamW only, train_transformer.py:126).
+#
+# Factoring rule (chosen so every `blocks` state array keeps the leading
+# stacked-layer axis — the interleaved-pipeline baking permutes axis 0 of
+# every blocks leaf):
+#   - ndim >= 3           -> factored over the LAST TWO axes, leading axes
+#                            kept as batch (R: shape[:-1], C: shape[:-2]+(c,))
+#   - ndim == 2 top-level -> factored (embeddings, lm_head)
+#   - ndim == 2 in blocks -> full v (stacked norm scales (L, d) — tiny, and
+#                            factoring would drop the leading L from C)
+#   - ndim <= 1           -> full v
+_ADAFACTOR_EPS1 = 1e-30  # inside sqrt: g^2 + eps1
+_ADAFACTOR_EPS2 = 1e-3   # not used in the plain-lr variant; kept for parity
+_ADAFACTOR_CLIP = 1.0    # update-RMS clipping threshold d
+
+
+def _adafactor_factored(path, leaf) -> bool:
+    if leaf.ndim >= 3:
+        return True
+    top = str(path[0].key) if hasattr(path[0], "key") else str(path[0])
+    return leaf.ndim == 2 and top != "blocks"
+
+
+def adafactor_init(params: Any) -> OptState:
+    def init_leaf(path, p):
+        if _adafactor_factored(path, p):
+            return {
+                "r": jnp.zeros(p.shape[:-1], jnp.float32),
+                "c": jnp.zeros(p.shape[:-2] + (p.shape[-1],), jnp.float32),
+            }
+        return {"full": jnp.zeros(p.shape, jnp.float32)}
+
+    return {
+        "v": jax.tree_util.tree_map_with_path(init_leaf, params),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def adafactor_update(
+    grads: Any,
+    state: OptState,
+    params: Any,
+    lr: jax.Array,
+    cfg: TrainConfig,
+) -> Tuple[Any, OptState]:
+    """One Adafactor step (beta1=0, update-RMS clipping, decoupled wd).
+
+    beta2 follows the paper's schedule 1 - t^-0.8 (no bias correction
+    needed); the step size is the trainer's lr schedule (not the paper's
+    relative-step variant) so runs stay comparable with AdamW configs.
+    """
+    count = state["count"] + 1
+    c = count.astype(jnp.float32)
+    b2t = 1.0 - c ** -0.8
+    wd = cfg.weight_decay
+    mask = decay_mask(params)
+
+    def leaf_update(g, v, p, decay):
+        g32 = g.astype(jnp.float32)
+        g2 = jnp.square(g32) + _ADAFACTOR_EPS1
+        if "full" in v:
+            v_new = {"full": b2t * v["full"] + (1.0 - b2t) * g2}
+            u = g32 * jax.lax.rsqrt(v_new["full"])
+        else:
+            r_new = b2t * v["r"] + (1.0 - b2t) * jnp.sum(g2, axis=-1)
+            c_new = b2t * v["c"] + (1.0 - b2t) * jnp.sum(g2, axis=-2)
+            v_new = {"r": r_new, "c": c_new}
+            denom = jnp.sum(r_new, axis=-1, keepdims=True)
+            # Normalize BEFORE the outer product: r and c are O(eps1)-small
+            # for zero-gradient slices, and (1e-30 * 1e-30) underflows fp32
+            # to 0 -> rsqrt(0)=inf -> 0*inf=NaN. r/sum(r) is O(1), so the
+            # product stays representable; the floor catches any residual
+            # underflow without touching legitimate small statistics.
+            v_hat = (r_new / denom)[..., :, None] * c_new[..., None, :]
+            u = g32 * jax.lax.rsqrt(jnp.maximum(v_hat, 1e-37))
+        rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
+        u = u / jnp.maximum(1.0, rms_u / _ADAFACTOR_CLIP)
+        if decay and wd > 0:
+            u = u + wd * p.astype(jnp.float32)
+        p_new = p.astype(jnp.float32) - lr * u
+        return p_new.astype(p.dtype), v_new
+
+    flat_g = jax.tree.leaves(grads)
+    treedef = jax.tree.structure(params)
+    # v's tree is deeper than params' (dict per param leaf); rebuild by
+    # walking params' flattened order against v's matching subtrees.
+    flat_v = jax.tree.leaves(
+        state["v"], is_leaf=lambda x: isinstance(x, dict) and ("full" in x or "r" in x)
+    )
+    flat_p = jax.tree.leaves(params)
+    flat_mask = jax.tree.leaves(mask)
+    new_p, new_v = [], []
+    for g, v, p, d in zip(flat_g, flat_v, flat_p, flat_mask):
+        pn, vn = leaf_update(g, v, p, d)
+        new_p.append(pn)
+        new_v.append(vn)
+    return (
+        jax.tree.unflatten(treedef, new_p),
+        {"v": jax.tree.unflatten(treedef, new_v), "count": count},
+    )
+
+
+def optimizer_init(params: Any, cfg: TrainConfig) -> OptState:
+    """Dispatch by cfg.optimizer ('adamw' | 'adafactor')."""
+    if cfg.optimizer == "adafactor":
+        return adafactor_init(params)
+    return adamw_init(params)
+
+
+def optimizer_update(
+    grads: Any, state: OptState, params: Any, lr: jax.Array, cfg: TrainConfig
+) -> Tuple[Any, OptState]:
+    if cfg.optimizer == "adafactor":
+        return adafactor_update(grads, state, params, lr, cfg)
+    return adamw_update(grads, state, params, lr, cfg)
+
+
 def learning_rate(step: jax.Array, cfg: TrainConfig) -> jax.Array:
     """LR schedule. The reference uses 10%-warmup-then-constant
     (train_transformer.py:43-49); warmup+cosine is the pretraining default."""

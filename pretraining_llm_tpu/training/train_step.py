@@ -40,7 +40,11 @@ TrainState = Dict[str, Any]
 
 def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
     params = transformer.init_params(cfg.model, key)
-    return {"params": params, "opt": opt.adamw_init(params), "step": jnp.zeros((), jnp.int32)}
+    return {
+        "params": params,
+        "opt": opt.optimizer_init(params, cfg.train),
+        "step": jnp.zeros((), jnp.int32),
+    }
 
 
 def state_pspec_tree(
@@ -49,13 +53,23 @@ def state_pspec_tree(
     """PartitionSpecs for the full train state (moments mirror params)."""
     kw = {"tensor_size": tensor_size}
     pspecs = param_pspec_tree(state["params"], pipeline, **kw)
-    return {
-        "params": pspecs,
-        "opt": {
+    if "v" in state["opt"]:
+        # Adafactor: the factored statistics are ~0.3 bytes/param — too
+        # small to be worth sharding (and their shapes don't match the
+        # param sharding rules). Replicate every statistic array.
+        opt_pspecs = {
+            "v": jax.tree.map(lambda _: P(), state["opt"]["v"]),
+            "count": P(),
+        }
+    else:
+        opt_pspecs = {
             "mu": param_pspec_tree(state["opt"]["mu"], pipeline, **kw),
             "nu": param_pspec_tree(state["opt"]["nu"], pipeline, **kw),
             "count": P(),
-        },
+        }
+    return {
+        "params": pspecs,
+        "opt": opt_pspecs,
         "step": P(),
     }
 
@@ -106,9 +120,14 @@ def bake_state_layout(state: TrainState, cfg: Config, forward: bool = True) -> T
     out["params"]["blocks"] = f(state["params"]["blocks"], s, v)
     if "opt" in state:
         out["opt"] = dict(state["opt"])
-        for m in ("mu", "nu"):
-            out["opt"][m] = dict(state["opt"][m])
-            out["opt"][m]["blocks"] = f(state["opt"][m]["blocks"], s, v)
+        # Every moment container mirroring the params' structure (adamw:
+        # mu/nu; adafactor: v — whose blocks arrays all keep the leading
+        # stacked-layer axis by the factoring rule) gets the same layout
+        # permutation as the params.
+        for m, sub in state["opt"].items():
+            if isinstance(sub, dict) and "blocks" in sub:
+                out["opt"][m] = dict(sub)
+                out["opt"][m]["blocks"] = f(sub["blocks"], s, v)
     return out
 
 
@@ -163,7 +182,9 @@ def _make_step_fn(cfg: Config, mesh: Optional[Mesh] = None):
             grad_norm = opt.global_norm(grads)
 
         lr = opt.learning_rate(state["step"], tcfg)
-        new_params, new_opt = opt.adamw_update(grads, state["opt"], state["params"], lr, tcfg)
+        new_params, new_opt = opt.optimizer_update(
+            grads, state["opt"], state["params"], lr, tcfg
+        )
         new_state = {"params": new_params, "opt": new_opt, "step": state["step"] + 1}
         metrics = {"loss": loss, "grad_norm": grad_norm, "lr": lr}
         return new_state, metrics

@@ -181,7 +181,7 @@ def main() -> int:
     KNOWN = {
         "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
         "sweep-full", "sweep2", "profile", "e2e", "batch-sweep",
-        "unroll-sweep", "mfu-350m",
+        "unroll-sweep", "mfu-350m", "mfu-1b",
     }
     want = None
     if args.stages:
@@ -327,11 +327,20 @@ def _run_stages(args, on, gated, py) -> None:
                  "--remat", "save_attn", "--timeout-budget", "800"] + extra,
                 920,
             )
-    # (No single-chip 1B stage: fp32 params + Adam moments alone are
-    # ~14.9 GB of the chip's 16 GB — the 1B/1.3B configs are multi-chip
-    # FSDP targets; their sharded memory story is covered by
-    # `scripts/train.py --compile-only` AOT analysis and the virtual-mesh
-    # dryrun instead.)
+    # Single-chip 1B via Adafactor: fp32 params + ADAM moments are ~14.9 GB
+    # of the 16 GB chip (impossible), but factored second moments are
+    # ~0.2 GB — params 4.96 + v 0.2 + bf16 copy 2.5 + grads 4.96 leaves
+    # room for full-remat activations at small batch. BASELINE config #4's
+    # model, trained where Adam cannot. OOM raises cleanly (no wedge).
+    if on("mfu-1b"):
+        for batch in (4, 8):
+            gated(
+                f"mfu-1b/adafactor/b{batch}",
+                [py, BENCH, "--skip-canary", "--preset", "llama-1b",
+                 "--optimizer", "adafactor", "--remat", "full",
+                 "--batch", str(batch), "--timeout-budget", "800"],
+                920,
+            )
 
     # 3b3. Layer-scan unroll at the winning config: unrolling trades
     # compile time + code size for cross-layer scheduling freedom.
