@@ -179,11 +179,22 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
     from pretraining_llm_tpu.models import transformer
 
     cfg = get_preset(args.preset).model
-    # The KV-cached forward always attends via the masked einsum path
-    # (per-step shapes are tiny; flash targets training) — --attention would
-    # be a silent no-op here, so reject it instead of mismeasuring.
-    if args.attention:
-        raise ValueError("--attention has no effect on the cached decode path")
+    # Train-only knobs are rejected, not ignored: a decode record emitted
+    # after `--block-q 256` or `--optimizer adafactor` would be
+    # indistinguishable from the default run while the operator believes
+    # they measured a different config. (--attention: the KV-cached forward
+    # always attends via the masked einsum path — per-step shapes are tiny,
+    # flash targets training.)
+    noop = {
+        "--attention": args.attention, "--remat": args.remat, "--ce": args.ce,
+        "--optimizer": args.optimizer, "--unroll": args.unroll,
+        "--block-q": args.block_q, "--block-kv": args.block_kv,
+    }
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(
+            f"{', '.join(bad)} have no effect on the cached decode path"
+        )
     if args.kv_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
     batch = args.batch or 8
@@ -267,6 +278,8 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
         model = dc.replace(model, remat="save_attn")
     if args.ce:
         model = dc.replace(model, ce_impl=args.ce)
+    if args.unroll:
+        model = dc.replace(model, scan_unroll=args.unroll)
     if args.block_q or args.block_kv:
         model = dc.replace(
             model, flash_block_q=args.block_q, flash_block_kv=args.block_kv
@@ -285,6 +298,7 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
         data=data,
         train=dc.replace(
             cfg.train,
+            optimizer=args.optimizer or cfg.train.optimizer,
             batch_size=batch,
             train_steps=steps,
             checkpoint_interval=0,

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Summarize tpu_capture.jsonl into a BASELINE.md-ready markdown table.
+
+Reads every record, keeps the LATEST successful (rc=0) record per stage,
+and prints grouped markdown rows — so after a capture campaign the
+documentation step is copy-paste, not JSONL archaeology.
+
+Usage:  python scripts/bank_results.py [--in tpu_capture.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--in", dest="inp", default="tpu_capture.jsonl")
+    args = ap.parse_args()
+    if not os.path.exists(args.inp):
+        print(f"no {args.inp}")
+        return 1
+    latest_ok: dict = {}
+    latest_any: dict = {}
+    order: list = []
+    with open(args.inp) as f:
+        for ln in f:
+            try:
+                r = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            stage = r.get("stage", "")
+            if not stage or stage in (
+                "campaign-start", "canary", "backend-recovered",
+                "recovery-budget-exhausted",
+            ):
+                continue
+            if stage not in latest_any:
+                order.append(stage)
+            latest_any[stage] = r
+            # A later FAILED rerun must not hide an earlier banked success
+            # (the docstring's contract): successes and failures tracked
+            # separately; a stage is "failed" only if it never succeeded.
+            if r.get("rc") == 0 and "error" not in r:
+                latest_ok[stage] = r
+
+    train_rows, other_rows, failed = [], [], []
+    for stage in order:
+        r = latest_ok.get(stage)
+        if r is None:
+            r_any = latest_any[stage]
+            failed.append((stage, r_any.get("error", f"rc={r_any.get('rc')}")))
+            continue
+        metric = r.get("metric", "")
+        if metric.startswith("mfu_") and "tokens_per_sec_chip" in r:
+            train_rows.append(
+                f"| {r.get('attention','?')}, {r.get('remat','?')} remat, "
+                f"{r.get('ce_impl','?')} CE, batch {r.get('batch','?')}"
+                f"{' (' + metric[4:].replace('_train','') + ')' if 'gpt2-124m' not in metric else ''} "
+                f"| {r['tokens_per_sec_chip']/1e3:.1f}k | {r.get('value',0)*100:.1f}% "
+                f"| stage {stage} |"
+            )
+        elif metric or "value" in r:
+            unit = r.get("unit", "")
+            other_rows.append(
+                f"| {stage} | {r.get('value','?')} {unit} "
+                f"| {metric or '-'} |"
+            )
+        else:
+            other_rows.append(f"| {stage} | ok | - |")
+
+    if train_rows:
+        print("### Train throughput rows\n")
+        print("| Config | tokens/sec/chip | MFU | notes |")
+        print("|---|---|---|---|")
+        print("\n".join(train_rows))
+    if other_rows:
+        print("\n### Other stages\n")
+        print("| Stage | Value | Metric |")
+        print("|---|---|---|")
+        print("\n".join(other_rows))
+    if failed:
+        print("\n### Failed / errored stages\n")
+        for stage, err in failed:
+            print(f"- {stage}: {str(err)[:160]}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

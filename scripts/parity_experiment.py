@@ -339,10 +339,26 @@ def main():
         print(f"corpus: {n/1e6:.2f} MB real text -> {train_bin}")
 
     def _steps_of(rec):
+        """(count, exact). Pre-"steps" records fall back to the last LOGGED
+        step — a LOWER bound (the true count may exceed it by up to
+        log_every-1), so callers must only flag mismatches they can prove."""
         if rec.get("steps") is not None:
-            return rec["steps"]
+            return rec["steps"], True
         curve = rec.get("curve") or []
-        return curve[-1]["step"] if curve else None  # pre-"steps" records
+        return (curve[-1]["step"], False) if curve else (None, False)
+
+    def _proven_mismatch(a, a_exact, b, b_exact):
+        if a is None or b is None:
+            return False
+        if a_exact and b_exact:
+            return a != b
+        # An exact count strictly below the other side's lower bound is the
+        # only provable mismatch; two bounds prove nothing.
+        if a_exact and not b_exact:
+            return a < b
+        if b_exact and not a_exact:
+            return b < a
+        return False
 
     results = {}
     if os.path.exists(results_path):
@@ -356,14 +372,15 @@ def main():
     # against — and clobbered — the recorded 1500-step twin).
     if args.only in ("jax", "torch"):
         other = results.get({"jax": "torch", "torch": "jax"}[args.only])
-        so = _steps_of(other) if other else None
-        if so is not None and so != args.steps:
+        so, so_exact = _steps_of(other) if other else (None, False)
+        if _proven_mismatch(args.steps, True, so, so_exact):
+            bound = "" if so_exact else "at least "
             print(json.dumps({
                 "error": f"step-count mismatch: --only {args.only} with "
                          f"--steps {args.steps}, but the recorded "
                          f"{'torch' if args.only == 'jax' else 'jax'} twin "
-                         f"ran {so} steps; rerun with --steps {so} (or "
-                         "retrain both sides)",
+                         f"ran {bound}{so} steps; rerun with a matching "
+                         "--steps (or retrain both sides)",
             }))
             return 2
 
@@ -374,9 +391,9 @@ def main():
     json.dump(results, open(results_path, "w"), indent=2)
 
     if "jax" in results and "torch" in results:
-        sj = _steps_of(results["jax"])
-        st = _steps_of(results["torch"])
-        if sj is not None and st is not None and sj != st:
+        sj, sj_exact = _steps_of(results["jax"])
+        st, st_exact = _steps_of(results["torch"])
+        if _proven_mismatch(sj, sj_exact, st, st_exact):
             # Belt-and-braces: records can still disagree (hand-edited file).
             print(json.dumps({
                 "error": f"step-count mismatch: jax ran {sj} steps, torch ran "

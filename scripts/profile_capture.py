@@ -30,7 +30,11 @@ apply_platform_env()
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="gpt2-124m")
-    ap.add_argument("--batch", type=int, default=24)
+    ap.add_argument(
+        "--batch", type=int, default=0,
+        help="0 = mode default (train: 24; decode: 8 — matching bench.py's "
+        "decode default so the trace explains the benchmark number)",
+    )
     ap.add_argument("--remat", default="")
     ap.add_argument("--attention", default="")
     ap.add_argument(
@@ -45,6 +49,8 @@ def main() -> None:
     ap.add_argument("--parse-only", action="store_true")
     args = ap.parse_args()
 
+    if not args.batch:
+        args.batch = 8 if args.mode == "decode" else 24
     if not args.parse_only:
         import jax
         import jax.numpy as jnp
