@@ -51,7 +51,15 @@ def main() -> int:
         r = latest_ok.get(stage)
         if r is None:
             r_any = latest_any[stage]
-            failed.append((stage, r_any.get("error", f"rc={r_any.get('rc')}")))
+            if "delta" in r_any and "error" not in r_any:
+                # Completed measurement that FAILED its numeric bar (e.g.
+                # parity delta > 0.01 now exits 1): the delta is the banked
+                # result — show it, don't reduce it to a bare rc.
+                failed.append((stage, f"delta {r_any['delta']} "
+                                      f"(pass={r_any.get('pass')})"))
+            else:
+                failed.append(
+                    (stage, r_any.get("error", f"rc={r_any.get('rc')}")))
             continue
         metric = r.get("metric", "")
         if metric.startswith("mfu_") and "tokens_per_sec_chip" in r:

@@ -403,10 +403,25 @@ def main():
             return 2
         ja, to = results["jax"]["eval_loss"], results["torch"]["eval_loss"]
         delta = abs(ja - to)
+        passed = delta <= 0.01
         print("\n=== PARITY ===")
         print(f"jax  ({results['jax']['backend']}): eval loss {ja:.4f}")
         print(f"torch (cpu fp32 baseline):          eval loss {to:.4f}")
-        print(f"delta {delta:.4f}  ({'PASS' if delta <= 0.01 else 'FAIL'} at +-0.01)")
+        print(f"delta {delta:.4f}  ({'PASS' if passed else 'FAIL'} at +-0.01)")
+        # Structured last line + nonzero exit on FAIL (ADVICE r3 medium):
+        # tpu_capture banks rc and the raw tail; bank_results classifies
+        # rc==0 records without an "error" key as success, so a FAIL that
+        # exits 0 is silently laundered into an "ok" row.
+        print(json.dumps({
+            "delta": round(delta, 6),
+            "pass": passed,
+            "jax_eval_loss": ja,
+            "torch_eval_loss": to,
+            "jax_backend": results["jax"]["backend"],
+            "steps": sj,
+        }))
+        if not passed:
+            return 1
     return 0
 
 

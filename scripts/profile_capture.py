@@ -43,7 +43,12 @@ def main() -> None:
         "instead of the train step — the ground truth for serving opt",
     )
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--out", default="/tmp/pllm_trace")
+    ap.add_argument(
+        "--out", default="",
+        help="trace dir; default derives from --mode (/tmp/pllm_trace vs "
+        "/tmp/pllm_trace_decode) so a failed decode trace can never be "
+        "silently satisfied by a stale train xplane (ADVICE r3)",
+    )
     ap.add_argument("--tool", default="hlo_stats")
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--parse-only", action="store_true")
@@ -51,6 +56,15 @@ def main() -> None:
 
     if not args.batch:
         args.batch = 8 if args.mode == "decode" else 24
+    if not args.out:
+        args.out = "/tmp/pllm_trace_decode" if args.mode == "decode" else "/tmp/pllm_trace"
+
+    def _xplanes():
+        return set(
+            glob.glob(os.path.join(args.out, "**", "*.xplane.pb"), recursive=True)
+        )
+
+    pre_existing = _xplanes()
     if not args.parse_only:
         import jax
         import jax.numpy as jnp
@@ -115,13 +129,19 @@ def main() -> None:
                     state, m = step(state, batch)
                 float(jax.device_get(m["loss"]))
 
-    planes = sorted(
-        glob.glob(os.path.join(args.out, "**", "*.xplane.pb"), recursive=True),
-        key=os.path.getmtime,
-    )
+    planes = sorted(_xplanes(), key=os.path.getmtime)
     if not planes:
         print(json.dumps({"error": f"no xplane.pb under {args.out}"}))
-        return
+        sys.exit(1)
+    if not args.parse_only and not (set(planes) - pre_existing):
+        # The profiler ran but produced no NEW trace: parsing the
+        # mtime-newest pre-existing file would print a stale trace (possibly
+        # from the other mode) labeled as this run's. Fail loudly instead.
+        print(json.dumps({
+            "error": f"profiler produced no new xplane under {args.out}; "
+            f"{len(planes)} stale file(s) present — refusing to parse them",
+        }))
+        sys.exit(1)
     from xprof.convert import raw_to_tool_data as rtd
 
     data, _ = rtd.xspace_to_tool_data([planes[-1]], args.tool, {})

@@ -8,16 +8,30 @@ priority order, each stage a bounded subprocess, appending structured
 results to a JSONL log as they land — a partial window still banks the
 most important numbers first.
 
+Hardware-window discipline (VERDICT r3 next #7), enforced in code: the
+round's driver-facing deliverables — (a) the driver-reproducible bench.py
+default [mfu], (b) parity-tpu, (c) e2e — are CRITICAL_STAGES and run first;
+any stage that probes a kernel-config class never proven on this backend
+(RISKY_STAGES: profiler instrumentation, int8-KV decode, scan-unroll
+overrides, the open-ended sweep grid) is DEFERRED until all three critical
+records are banked in the campaign log. Two full rounds lost their headline
+number to probe-induced wedges (save_attn+fused CE, flash block-512
+overrides) during the only hardware window; the ordering is now policy,
+not convention. Override for manual debugging only: --force-risky.
+
 Stages (priority order):
   1. canary        — environment probe (bench.py --_canary); abort if dead
   2. mfu           — the driver metric: bench.py default race (gpt2-124m)
-  3. sweep-top     — the 4 most promising perf-sweep configs
-  4. decode        — KV-cached decode throughput (+ ragged serving shape)
-  5. ctx8k         — single-chip flash at 8k (gpt2-8k-sp)
-  6. trainer       — full Trainer loop, prefetch 0 vs 2 (overlap win)
-  7. parity-tpu    — scripts/parity_experiment.py with pinned matmul
+  3. parity-tpu    — scripts/parity_experiment.py with pinned matmul
                      precision (the BASELINE.md promised TPU rerun)
-  8. sweep-full    — the remaining perf-sweep grid
+  4. e2e           — train -> SIGTERM -> resume -> evaluate, on chip
+  5. sweep-top     — the most promising perf-sweep configs (proven classes)
+  6. batch-sweep / mfu-350m / mfu-1b / sweep2 — batch knee, larger BASELINE
+                     models, second-wave sweep (proven classes)
+  7. decode        — KV-cached decode throughput (+ ragged serving shape)
+  8. ctx8k / trainer — 8k context, trainer-loop overlap
+  9. [risky, gated] profile / profile-decode / decode-int8 / unroll-sweep /
+                    sweep-full
 
 Usage:
   python scripts/tpu_capture.py                 # full campaign
@@ -36,6 +50,63 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
+
+# The three records the round cannot end without (VERDICT r3 #7): the
+# driver's reproducible number, TPU-side loss parity, and the on-chip
+# end-to-end exercise.
+CRITICAL_STAGES = ("mfu", "parity-tpu", "e2e")
+
+# Kernel-config classes never proven on this backend. Every chip wedge so
+# far came from exactly such a probe (save_attn+fused CE, block-512
+# overrides) — and a wedge costs the rest of the hardware window, so these
+# may only run once every critical record is banked.
+RISKY_STAGES = frozenset(
+    {"profile", "profile-decode", "decode-int8", "unroll-sweep", "sweep-full"}
+)
+
+
+def _critical_banked(out_path: str) -> set:
+    """Critical stages whose LATEST record in the campaign log is a
+    completed measurement.
+
+    mfu/e2e count when they succeeded (rc==0, no error). parity-tpu counts
+    when the measurement COMPLETED — its structured last line carries a
+    "delta" key whether it passed or failed (an honest numeric FAIL is a
+    banked result, not a lost window; only a crash/hang leaves it unbanked).
+
+    Latest-record-per-stage semantics: the default log is append-only
+    across campaigns, and a stale success from a previous round must not
+    unlock risky probes on a backend whose mfu/e2e just FAILED this
+    campaign — the most recent attempt decides.
+    """
+    latest: dict = {}
+    try:
+        with open(out_path) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                stage = r.get("stage", "")
+                if stage in CRITICAL_STAGES:
+                    latest[stage] = r
+    except OSError:
+        pass
+    done: set = set()
+    for stage, r in latest.items():
+        if "error" in r:
+            continue
+        if stage == "parity-tpu":
+            # Regardless of rc: only a structured delta is a measurement.
+            # An rc==0 run that never compared curves (e.g. the torch twin
+            # record was missing, so the script trained one side and
+            # exited 0) must not unlock risky probes — that is exactly the
+            # spurious-record shape that burned round 3.
+            if "delta" in r:
+                done.add(stage)
+        elif r.get("rc") == 0:
+            done.add(stage)
+    return done
 
 sys.path.insert(0, REPO)
 import bench as _bench  # noqa: E402 — one definition of "healthy canary"
@@ -177,11 +248,15 @@ def main() -> int:
         help="campaign-wide budget (seconds) for polling backend recovery "
         "across ALL outages; when exhausted the campaign aborts (stages are "
         "never skipped while the backend is down)")
+    ap.add_argument(
+        "--force-risky", action="store_true",
+        help="run RISKY_STAGES even before the critical records are banked "
+        "(manual debugging only — this is how two rounds lost their number)")
     args = ap.parse_args()
     KNOWN = {
-        "mfu", "sweep-top", "decode", "ctx8k", "trainer", "parity-tpu",
-        "sweep-full", "sweep2", "profile", "e2e", "batch-sweep",
-        "unroll-sweep", "mfu-350m", "mfu-1b",
+        "mfu", "sweep-top", "decode", "decode-int8", "ctx8k", "trainer",
+        "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
+        "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
     }
     want = None
     if args.stages:
@@ -237,14 +312,37 @@ def main() -> int:
                 raise _Abort(name)
             # rc=0 can still leave the backend dead: bench.py reports a
             # banked result (rc=0) even when a later candidate wedged the
-            # chip — it marks the record instead.
+            # chip — it marks the record instead. Conversely a COMPLETED
+            # measurement that failed its numeric bar (parity rc=1 with a
+            # structured "delta") ran to a clean exit: nothing hung, no
+            # wedge mechanism fired, no recovery gate needed.
+            clean_exit = rec.get("rc") == 0 or "delta" in rec
             gate_state["needed"] = (
-                rec.get("rc") != 0 or bool(rec.get("backend_wedged"))
+                not clean_exit or bool(rec.get("backend_wedged"))
             )
             return rec
 
+        def risky(name: str, cmd: list, timeout: float) -> dict:
+            """Risk-policy gate (VERDICT r3 #7): a stage probing an unproven
+            kernel-config class runs ONLY after every critical record is
+            banked. A deferred stage writes a structured skip record — the
+            campaign log shows the policy fired, not a silent gap."""
+            if not args.force_risky:
+                banked = _critical_banked(args.out)
+                missing = [s for s in CRITICAL_STAGES if s not in banked]
+                if missing:
+                    rec = {"stage": name, "skipped": True, "risk": "unproven",
+                           "error": "deferred by risk policy: critical "
+                                    f"stages not yet banked: {missing}"}
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()
+                    print(f"[capture] {name} deferred (risk policy): "
+                          f"missing {missing}", flush=True)
+                    return rec
+            return gated(name, cmd, timeout)
+
         try:
-            _run_stages(args, on, gated, py)
+            _run_stages(args, on, gated, risky, py)
         except _Abort as stage:
             print(f"[capture] recovery budget exhausted at stage {stage}; "
                   "aborting campaign", flush=True)
@@ -252,7 +350,7 @@ def main() -> int:
     return 0
 
 
-def _run_stages(args, on, gated, py) -> None:
+def _run_stages(args, on, gated, risky, py) -> None:
     # 2. The driver metric (races remat candidates incl. safe tail).
     if on("mfu"):
         gated(
@@ -262,7 +360,33 @@ def _run_stages(args, on, gated, py) -> None:
             args.mfu_budget + 120,
         )
 
-    # 3. Most promising sweep points first. NOTE: save_attn+fused is
+    # 3. TPU-side parity at pinned matmul precision — CRITICAL: banked
+    # before any sweep (the script pins jax_default_matmul_precision=
+    # "highest" itself; BASELINE.md:60-63's promised rerun). The torch
+    # side runs on host CPU; --only jax reuses the recorded torch curve.
+    # --steps MUST match the recorded torch curve (1500): a shorter
+    # partial rerun overwrites the jax record and the final-loss delta
+    # becomes meaningless (the script also guards this itself, and a
+    # numeric FAIL now exits 1 with a structured {"delta": ...} line).
+    if on("parity-tpu"):
+        gated(
+            "parity-tpu",
+            [py, os.path.join(REPO, "scripts", "parity_experiment.py"),
+             "--steps", "1500", "--only", "jax"],
+            3600,
+        )
+
+    # 4. End-to-end operational exercise on the real chip — CRITICAL:
+    # real-corpus train -> SIGTERM preemption -> resume -> evaluate,
+    # through the CLIs (VERDICT r2 #3 / r3 next #4).
+    if on("e2e"):
+        gated(
+            "e2e",
+            [py, os.path.join(REPO, "scripts", "tpu_e2e.py"), "--steps", "300"],
+            1800,
+        )
+
+    # 5. Most promising sweep points first. NOTE: save_attn+fused is
     # EXCLUDED — measured on-chip (round 3) to hang the device after
     # warmup, twice reproducibly, wedging the backend for later stages.
     if on("sweep-top"):
@@ -278,30 +402,7 @@ def _run_stages(args, on, gated, py) -> None:
                 1020,
             )
 
-    # 3b. Second-wave sweep: remaining unmeasured points — batch 48 (does
-    # throughput keep falling past 32?) and the 8k preset under the remat
-    # policies that won at 1k context.
-    if on("sweep2"):
-        # Measured 2026-07-31: save_qkv_attn/b24 0.3964, /b32 0.3928 (loses
-        # to save_attn 0.4059 — saving more residuals costs more HBM than
-        # the recompute it avoids). --block-q 512 --block-kv 512 at T=1024
-        # HUNG the chip (killed at 700s; same Mosaic-class wedge as
-        # save_attn+fused) — block overrides are now excluded from
-        # campaigns; the auto block size stands.
-        for extra in (
-            ["--remat", "save_attn", "--batch", "48"],
-            # The 8k preset's remat is dots_saveable (0.2475 measured);
-            # save_attn won every gpt2-124m point — try it at 8k too.
-            ["--preset", "gpt2-8k-sp", "--remat", "save_attn"],
-            ["--preset", "gpt2-8k-sp", "--remat", "save_big"],
-        ):
-            gated(
-                "sweep2:" + "/".join(extra).replace("--", ""),
-                [py, BENCH, "--skip-canary", "--timeout-budget", "900"] + extra,
-                1020,
-            )
-
-    # 3b2. Batch micro-sweep around the wave-1 winner (b16 > b24 > b32 at
+    # 6a. Batch micro-sweep around the wave-1 winner (b16 > b24 > b32 at
     # save_attn/chunked): find the throughput knee. (No block-size points:
     # block overrides hang this backend — see the sweep2 comment above.)
     if on("batch-sweep"):
@@ -315,7 +416,7 @@ def _run_stages(args, on, gated, py) -> None:
                 820,
             )
 
-    # 3b2b. The other BASELINE model configs on the one chip: 350M
+    # 6b. The other BASELINE model configs on the one chip: 350M
     # (BASELINE config #2's model, mesh collapsed to 1 device) and the
     # Llama-style 1B (config #4) at a batch its optimizer state + remat
     # leave room for. OOM raises cleanly — it cannot wedge the chip.
@@ -342,56 +443,40 @@ def _run_stages(args, on, gated, py) -> None:
                 920,
             )
 
-    # 3b3. Layer-scan unroll at the winning config: unrolling trades
-    # compile time + code size for cross-layer scheduling freedom.
-    if on("unroll-sweep"):
-        for unroll in (2, 4):
+    # 6c. Second-wave sweep: remaining unmeasured points — batch 48 (does
+    # throughput keep falling past 32?) and the 8k preset under the remat
+    # policies that won at 1k context.
+    if on("sweep2"):
+        # Measured 2026-07-31: save_qkv_attn/b24 0.3964, /b32 0.3928 (loses
+        # to save_attn 0.4059 — saving more residuals costs more HBM than
+        # the recompute it avoids). --block-q 512 --block-kv 512 at T=1024
+        # HUNG the chip (killed at 700s; same Mosaic-class wedge as
+        # save_attn+fused) — block overrides are now excluded from
+        # campaigns; the auto block size stands.
+        for extra in (
+            ["--remat", "save_attn", "--batch", "48"],
+            # The 8k preset's remat is dots_saveable (0.2475 measured);
+            # save_attn won every gpt2-124m point — try it at 8k too.
+            ["--preset", "gpt2-8k-sp", "--remat", "save_attn"],
+            ["--preset", "gpt2-8k-sp", "--remat", "save_big"],
+        ):
             gated(
-                f"unroll:{unroll}",
-                [py, BENCH, "--skip-canary", "--remat", "save_attn",
-                 "--batch", "16", "--unroll", str(unroll),
-                 "--timeout-budget", "700"],
-                820,
+                "sweep2:" + "/".join(extra).replace("--", ""),
+                [py, BENCH, "--skip-canary", "--timeout-budget", "900"] + extra,
+                1020,
             )
 
-    # 3c. Op-level trace at the measured-best config: the ground truth for
-    # what to attack next (prints the top HLO ops by self time).
-    if on("profile"):
-        gated(
-            "profile",
-            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
-             "--preset", "gpt2-124m", "--batch", "16",
-             "--remat", "save_attn", "--top", "40"],
-            900,
-        )
-        # Serving-side ground truth: the decode step is ~7x off the weight-
-        # read memory bound (2.08 ms/step vs ~0.3 theoretical) — find out
-        # where those milliseconds go.
-        # Distinct --out: profile_capture parses the mtime-newest xplane
-        # under its out dir — sharing the train stage's dir would let a
-        # no-op decode trace silently print the TRAIN table as decode.
-        gated(
-            "profile-decode",
-            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
-             "--preset", "gpt2-124m", "--batch", "8", "--mode", "decode",
-             "--steps", "2", "--top", "40", "--out", "/tmp/pllm_trace_decode"],
-            900,
-        )
-
-    # 4. Decode throughput: dense bucketed + ragged serving shape.
+    # 7. Decode throughput: dense bucketed + ragged serving shape (the
+    # cached-decode path is proven on this backend; int8-KV is NOT — it is
+    # its own risky stage below).
     if on("decode"):
         gated("decode", [py, BENCH, "--skip-canary", "--mode", "decode"], 900)
         gated(
             "decode-ragged",
             [py, BENCH, "--skip-canary", "--mode", "decode", "--ragged"], 900,
         )
-        gated(
-            "decode-int8",
-            [py, BENCH, "--skip-canary", "--mode", "decode",
-             "--kv-dtype", "int8"], 900,
-        )
 
-    # 5. 8k context on one chip (flash; the SP mesh needs multi-chip).
+    # 8. 8k context on one chip (flash; the SP mesh needs multi-chip).
     if on("ctx8k"):
         gated(
             "ctx8k",
@@ -400,46 +485,75 @@ def _run_stages(args, on, gated, py) -> None:
             1320,
         )
 
-    # 6. Trainer-loop overlap: prefetch 0 vs 2 (VERDICT r2 #8 number).
+    # 8b. Trainer-loop overlap: prefetch 0 vs 2 (VERDICT r2 #8 number).
     # 60 steps, not 20: the timed window holds 2 log-boundary pipeline
     # drains (~1 step latency each) regardless of length — at 20 steps
     # that's ~10% phantom "loop overhead", at 60 it is ~3%.
+    # --batch 24 is PINNED (ADVICE r3 low #3): the banked prefetch series
+    # (BASELINE.md trainer-loop table) was measured at batch 24; bench.py's
+    # train default later moved to 16, and an unpinned stage would silently
+    # extend the series with incomparable points.
     if on("trainer"):
         for depth in (0, 2):
             gated(
                 f"trainer-prefetch{depth}",
-                [py, BENCH, "--skip-canary", "--mode", "trainer",
-                 "--prefetch", str(depth), "--steps", "60"],
+                [py, BENCH, "--skip-canary", "--mode", "trainer", "--batch",
+                 "24", "--prefetch", str(depth), "--steps", "60"],
                 1020,
             )
 
-    # 7. TPU-side parity (the script pins jax_default_matmul_precision=
-    # "highest" itself — BASELINE.md:60-63's promised rerun). The torch
-    # side runs on host CPU; --only jax reuses the recorded torch curve.
-    if on("parity-tpu"):
-        # --steps MUST match the recorded torch curve (1500): a shorter
-        # partial rerun overwrites the jax record and the final-loss delta
-        # becomes meaningless (the script now also guards this itself).
-        gated(
-            "parity-tpu",
-            [py, os.path.join(REPO, "scripts", "parity_experiment.py"),
-             "--steps", "1500", "--only", "jax"],
-            3600,
+    # --- RISKY TIER from here down: unproven kernel-config classes, run
+    # only after mfu + parity-tpu + e2e are banked (see module docstring).
+
+    # 9a. Op-level trace at the measured-best config: the ground truth for
+    # what to attack next (prints the top HLO ops by self time). The
+    # profiler has never run on this backend — risky.
+    if on("profile"):
+        risky(
+            "profile",
+            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
+             "--preset", "gpt2-124m", "--batch", "16",
+             "--remat", "save_attn", "--top", "40"],
+            900,
+        )
+    # 9b. Serving-side ground truth: the decode step is ~7x off the weight-
+    # read memory bound (2.08 ms/step vs ~0.3 theoretical) — find out
+    # where those milliseconds go. (profile_capture now derives a
+    # decode-specific --out itself and refuses to parse stale xplanes.)
+    if on("profile-decode"):
+        risky(
+            "profile-decode",
+            [py, os.path.join(REPO, "scripts", "profile_capture.py"),
+             "--preset", "gpt2-124m", "--batch", "8", "--mode", "decode",
+             "--steps", "2", "--top", "40"],
+            900,
         )
 
-    # 7b. End-to-end operational exercise on the real chip: real-corpus
-    # train -> SIGTERM preemption -> resume -> evaluate, through the CLIs
-    # (VERDICT r2 #3's "real on-chip training run").
-    if on("e2e"):
-        gated(
-            "e2e",
-            [py, os.path.join(REPO, "scripts", "tpu_e2e.py"), "--steps", "300"],
-            1800,
+    # 9c. int8-KV decode: the quantized cache kernel path has only CPU
+    # evidence — an unproven class on this backend.
+    if on("decode-int8"):
+        risky(
+            "decode-int8",
+            [py, BENCH, "--skip-canary", "--mode", "decode",
+             "--kv-dtype", "int8"], 900,
         )
 
-    # 8. The rest of the grid.
+    # 9d. Layer-scan unroll at the winning config: unrolling trades
+    # compile time + code size for cross-layer scheduling freedom — a
+    # compile class never exercised on this backend.
+    if on("unroll-sweep"):
+        for unroll in (2, 4):
+            risky(
+                f"unroll:{unroll}",
+                [py, BENCH, "--skip-canary", "--remat", "save_attn",
+                 "--batch", "16", "--unroll", str(unroll),
+                 "--timeout-budget", "700"],
+                820,
+            )
+
+    # 9e. The rest of the grid — RISKY (open-ended combos).
     if on("sweep-full"):
-        gated(
+        risky(
             "sweep-full",
             [py, os.path.join(REPO, "scripts", "perf_sweep.py"),
              "--budget", "600"],

@@ -175,6 +175,54 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
     assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
 
 
+def test_environment_error_carries_last_banked(monkeypatch, capsys):
+    # VERDICT r3 #8: when the backend is dead the driver's JSON must point
+    # at the banked evidence, not leave a bare 0.0.
+    banked = {"metric": "mfu_gpt2-124m_train", "value": 0.416,
+              "unit": "fraction_of_peak_bf16", "stage": "bsweep:batch/16",
+              "capture_path": "data/captures/tpu_capture_r03.jsonl",
+              "commit": "abc1234 2026-07-31T00:00:00+00:00"}
+    monkeypatch.setattr(bench, "_last_banked", lambda metric: dict(banked))
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[HUNG],
+        canary_script=[(False, "canary hung past 150s (backend unreachable)")],
+    )
+    assert rc == 1
+    assert rec.get("environment_error") is True
+    assert rec["last_banked"]["value"] == 0.416
+    assert rec["last_banked"]["capture_path"].startswith("data/captures/")
+
+
+def test_last_banked_scans_capture_jsonl(tmp_path, monkeypatch):
+    # The scanner must pick the best rc==0 record for the metric, skipping
+    # error records, other metrics, and the known-bogus rc==0-with-error
+    # shape (ADVICE r3 medium: a FAIL record now carries an error marker).
+    cap = tmp_path / "data" / "captures"
+    cap.mkdir(parents=True)
+    recs = [
+        {"stage": "mfu", "rc": 0, "metric": "mfu_gpt2-124m_train",
+         "value": 0.406, "unit": "fraction_of_peak_bf16"},
+        {"stage": "bsweep:batch/16", "rc": 0, "metric": "mfu_gpt2-124m_train",
+         "value": 0.416, "unit": "fraction_of_peak_bf16", "batch": 16},
+        {"stage": "mfu", "rc": 1, "metric": "mfu_gpt2-124m_train",
+         "value": 0.9},  # failed stage: ignored
+        {"stage": "decode", "rc": 0,
+         "metric": "decode_tokens_per_sec_gpt2-124m", "value": 3841.0},
+        {"stage": "mfu", "rc": 0, "metric": "mfu_gpt2-124m_train",
+         "value": 0.0, "error": "environment: dead"},  # error: ignored
+    ]
+    with open(cap / "tpu_capture_r99.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    best = bench._last_banked("mfu_gpt2-124m_train", repo=str(tmp_path))
+    assert best is not None
+    assert best["value"] == 0.416
+    assert best["stage"] == "bsweep:batch/16"
+    assert best["capture_path"].endswith("tpu_capture_r99.jsonl")
+    assert bench._last_banked("mfu_llama-1b_train", repo=str(tmp_path)) is None
+
+
 def test_structured_inner_error_is_relayed(monkeypatch, capsys):
     # Deterministic inner failures relay the inner run's structured record.
     inner = {"metric": "mfu_gpt2-124m_train", "value": 0.0,

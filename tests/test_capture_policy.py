@@ -1,0 +1,127 @@
+"""Tests for the capture campaign's hardware-window risk policy.
+
+VERDICT r3 next #7: two rounds lost their driver-facing number because an
+unproven kernel-config probe wedged the chip during the only hardware
+window. The policy — critical stages (mfu, parity-tpu, e2e) banked before
+ANY risky probe — is now code in scripts/tpu_capture.py; these tests pin
+the classification logic it rests on.
+"""
+
+import importlib
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+tpu_capture = importlib.import_module("tpu_capture")
+
+
+def _write(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_critical_stage_set_is_the_verdict_trio():
+    assert set(tpu_capture.CRITICAL_STAGES) == {"mfu", "parity-tpu", "e2e"}
+
+
+def test_risky_stages_cover_the_unproven_classes():
+    # Every class that has wedged (or never touched) this backend must be
+    # behind the gate; the proven capture stages must NOT be.
+    assert {"profile", "profile-decode", "decode-int8", "unroll-sweep",
+            "sweep-full"} <= tpu_capture.RISKY_STAGES
+    for proven in ("mfu", "parity-tpu", "e2e", "decode", "ctx8k", "trainer",
+                   "sweep-top", "batch-sweep", "mfu-350m", "mfu-1b"):
+        assert proven not in tpu_capture.RISKY_STAGES
+
+
+def test_critical_banked_requires_all_three(tmp_path):
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "campaign-start"},
+        {"stage": "mfu", "rc": 0, "metric": "mfu_gpt2-124m_train",
+         "value": 0.41},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == {"mfu"}
+
+    _write(out, [
+        {"stage": "mfu", "rc": 0, "value": 0.41},
+        {"stage": "parity-tpu", "rc": 0, "delta": 0.0003, "pass": True},
+        {"stage": "e2e", "rc": 0, "all_checks": True},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == {
+        "mfu", "parity-tpu", "e2e"}
+
+
+def test_failed_critical_stage_does_not_count(tmp_path):
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "mfu", "rc": 0, "value": 0.0,
+         "error": "environment: backend unreachable"},
+        {"stage": "e2e", "rc": -1, "error": "stage hung past 1800s"},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == set()
+
+
+def test_honest_parity_fail_counts_as_banked(tmp_path):
+    # A numeric parity FAIL exits 1 (ADVICE r3 medium fix) but the
+    # measurement is complete — the window was not lost, risky probes may
+    # proceed. A parity CRASH (no delta) must not count.
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "parity-tpu", "rc": 1, "delta": 0.0112, "pass": False},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == {"parity-tpu"}
+    _write(out, [
+        {"stage": "parity-tpu", "rc": 1,
+         "raw": "Traceback (most recent call last): ..."},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == set()
+
+
+def test_parity_rc0_without_delta_does_not_count(tmp_path):
+    # An --only jax run with the torch twin record missing trains one side
+    # and exits 0 WITHOUT comparing curves — no delta, no measurement, no
+    # unlock (code-review r4 finding).
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "parity-tpu", "rc": 0,
+         "raw": "[jax] step 1500 loss 1.18"},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == set()
+
+
+def test_missing_log_means_nothing_banked(tmp_path):
+    assert tpu_capture._critical_banked(str(tmp_path / "absent.jsonl")) == set()
+
+
+def test_latest_record_wins_over_stale_success(tmp_path):
+    # The default log is append-only across campaigns: a round-N success
+    # must not unlock risky probes when THIS campaign's rerun just failed
+    # (code-review r4 finding on the first policy draft).
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "campaign-start"},
+        {"stage": "mfu", "rc": 0, "value": 0.41},
+        {"stage": "campaign-start"},
+        {"stage": "mfu", "rc": -1, "error": "stage hung past 2520s"},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == set()
+    # ...and a later recovery re-banks it.
+    with open(out, "a") as f:
+        f.write(json.dumps({"stage": "mfu", "rc": 0, "value": 0.40}) + "\n")
+    assert tpu_capture._critical_banked(str(out)) == {"mfu"}
+
+
+def test_annotated_parity_record_does_not_count(tmp_path):
+    # A parity record carrying BOTH a delta and a curation "error"
+    # annotation (e.g. superseded as spurious) must not unlock the gate.
+    out = tmp_path / "cap.jsonl"
+    _write(out, [
+        {"stage": "parity-tpu", "rc": 1, "delta": 1.1571,
+         "error": "superseded: spurious step-count mismatch"},
+    ])
+    assert tpu_capture._critical_banked(str(out)) == set()
