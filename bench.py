@@ -595,12 +595,18 @@ def _run_canary(timeout: float):
     return False, f"canary failed rc={proc.returncode}: {tail}"
 
 
-def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: str = ""):
-    """One fresh-subprocess inner run. Returns (json_dict|None, err_str)."""
+def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: str = "",
+             batch_override: int = 0):
+    """One fresh-subprocess inner run. Returns (json_dict|None, err_str).
+
+    ``batch_override``: per-candidate batch for race rungs whose measured
+    best lives at a different batch than the preset default (e.g.
+    remat=none fits only at small batch); 0 = use args.batch.
+    """
     cmd = [
         sys.executable, os.path.abspath(__file__), "--_inner",
         "--preset", args.preset,
-        "--batch", str(args.batch),
+        "--batch", str(batch_override or args.batch),
         "--steps", str(args.steps),
         "--warmup", str(args.warmup),
     ]
@@ -694,17 +700,28 @@ def wrapper_main(args: argparse.Namespace) -> int:
         and args.preset == "gpt2-124m"
     )
     if race:
-        # (remat, attention) candidates, measured-best first (v5e on-chip
-        # sweep 2026-07-31: save_attn > save_qkv_attn > save_big at every
-        # batch). The tail is the KNOWN-GOOD ladder (VERDICT r2 next #1c):
-        # 'full' remat + flash is the round-1-measured-safe config, and
-        # naive attention last — a pathology in any one policy can cost
-        # bounded attempts, never the round's number.
+        # (remat, attention, batch_override) candidates, measured-best
+        # first (v5e on-chip sweep 2026-07-31: save_attn > save_qkv_attn >
+        # save_big at every batch). Second rung: remat=none at batch 8 —
+        # ZERO recompute, so the honest-MFU ceiling rises by the ~25%
+        # save_attn charges to recomputation; CPU AOT says it fits (true
+        # peak ~14.5 GiB of 16; a clean OOM costs one bounded attempt).
+        # The tail is the KNOWN-GOOD ladder (VERDICT r2 next #1c): 'full'
+        # remat + flash is the round-1-measured-safe config, and naive
+        # attention last — a pathology in any one policy can cost bounded
+        # attempts, never the round's number. The race reports the BEST
+        # success, so `python bench.py` reproduces whichever rung wins.
         candidates = [
-            ("save_attn", ""), ("save_big", ""), ("full", ""), ("full", "naive"),
+            ("save_attn", "", 0), ("none", "", 8),
+            ("save_big", "", 0), ("full", "", 0), ("full", "naive", 0),
         ]
+        if args.batch:
+            # An explicit --batch is a series point the caller chose; a rung
+            # that would silently answer it at a different batch is dropped
+            # (remat=none at a large explicit batch would only OOM anyway).
+            candidates = [c for c in candidates if not c[2]]
     else:
-        candidates = [(args.remat, "")]
+        candidates = [(args.remat, "", 0)]
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
     best = None
@@ -714,7 +731,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
         "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
         "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
     )
-    for ci, (remat, attention) in enumerate(candidates):
+    for ci, (remat, attention, batch_over) in enumerate(candidates):
         # Reserve budget up front: a pathological first candidate may spend
         # at most its fair share, never the safe fallback's.
         remaining = deadline - time.monotonic()
@@ -726,7 +743,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
             if remaining <= 5:
                 break
             attempts += 1
-            rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining), attention)
+            rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining), attention,
+                                batch_over)
             if rec is not None and not err:
                 if best is None or rec.get("value", 0) > best.get("value", 0):
                     best = rec
@@ -734,6 +752,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
             last_err = (
                 f"attempt {attempts} (remat={remat or 'default'}"
                 + (f", attention={attention}" if attention else "")
+                + (f", batch={batch_over}" if batch_over else "")
                 + f"): {err}"
             )
             if rec is not None:

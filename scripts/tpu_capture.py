@@ -406,12 +406,25 @@ def _run_stages(args, on, gated, risky, py) -> None:
     # save_attn/chunked): find the throughput knee. (No block-size points:
     # block overrides hang this backend — see the sweep2 comment above.)
     if on("batch-sweep"):
+        # remat=none points (store everything, ZERO recompute): analytic MFU
+        # charges remat recompute as waste, so if the activations fit, the
+        # honest number jumps. CPU AOT memory analysis (r4), true peak =
+        # args + temps (outputs alias donated state): none/b4 ~8.8 GiB,
+        # none/b8 ~14.5 GiB (both fit v5e 16 GB; b12 ~20.3 GiB does not).
+        # XLA checkpoint policy is a proven class on this backend — same
+        # compile path as the measured remat points.
         for extra in (
-            ["--batch", "8"], ["--batch", "12"], ["--batch", "20"],
+            ["--remat", "save_attn", "--batch", "8"],
+            ["--remat", "save_attn", "--batch", "12"],
+            ["--remat", "save_attn", "--batch", "20"],
+            ["--remat", "none", "--batch", "4"],
+            ["--remat", "none", "--batch", "8"],
+            ["--remat", "save_big", "--batch", "8"],
+            ["--remat", "save_big", "--batch", "16"],
         ):
             gated(
                 "bsweep:" + "/".join(extra).replace("--", ""),
-                [py, BENCH, "--skip-canary", "--remat", "save_attn",
+                [py, BENCH, "--skip-canary",
                  "--timeout-budget", "700"] + extra,
                 820,
             )
@@ -433,8 +446,12 @@ def _run_stages(args, on, gated, risky, py) -> None:
     # ~0.2 GB — params 4.96 + v 0.2 + bf16 copy 2.5 + grads 4.96 leaves
     # room for full-remat activations at small batch. BASELINE config #4's
     # model, trained where Adam cannot. OOM raises cleanly (no wedge).
+    # Batch points sized by CPU AOT memory analysis (r4): true peak
+    # (args + temps; outputs alias donated state) is ~13.5 GiB at b2,
+    # ~16.3 GiB at b4 — b2 fits the 16 GB chip, b4 is a marginal probe
+    # (clean OOM if not), b8 (~22 GiB) was dropped.
     if on("mfu-1b"):
-        for batch in (4, 8):
+        for batch in (2, 4):
             gated(
                 f"mfu-1b/adafactor/b{batch}",
                 [py, BENCH, "--skip-canary", "--preset", "llama-1b",

@@ -55,9 +55,10 @@ def _run(monkeypatch, capsys, attempts_script, canary_script, args=None):
     monkeypatch.setattr(bench, "time", ft)
     calls = {"attempts": [], "canaries": 0}
 
-    def fake_attempt(a, remat, timeout, attention=""):
+    def fake_attempt(a, remat, timeout, attention="", batch_override=0):
         rec, err = attempts_script.pop(0)
         calls["attempts"].append((remat, attention))
+        calls.setdefault("batches", []).append(batch_override)
         ft.sleep(timeout if "hung" in err else 5.0)
         return rec, err
 
@@ -93,7 +94,7 @@ def test_hang_with_live_canary_moves_to_next_candidate(monkeypatch, capsys):
     )
     assert rc == 0
     assert rec["value"] == 0.41
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
     assert calls["canaries"] == 1  # exactly one cheap probe after the hang
 
 
@@ -128,7 +129,7 @@ def test_wedged_then_recovered_retries_same_candidate(monkeypatch, capsys):
     assert rc == 0
     assert rec["value"] == 0.40  # best of the race, from the retried candidate
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "save_big"]
+        "save_attn", "save_attn", "none"]
 
 
 def test_double_hang_abandons_candidate(monkeypatch, capsys):
@@ -144,7 +145,7 @@ def test_double_hang_abandons_candidate(monkeypatch, capsys):
     assert rc == 0
     assert rec["value"] == 0.39
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "save_big"]
+        "save_attn", "save_attn", "none"]
 
 
 def test_wedge_with_banked_result_reports_it_immediately(monkeypatch, capsys):
@@ -172,7 +173,25 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
     )
     assert rc == 0
     assert rec["value"] == 0.41
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
+    # The remat=none rung must reach the inner run at ITS measured batch.
+    assert calls["batches"] == [0, 8]
+
+
+def test_explicit_batch_drops_override_rungs(monkeypatch, capsys):
+    # `--batch 24` is a series point the caller chose; the race must not
+    # silently answer it with a batch-8 measurement (code-review r4). The
+    # none@8 rung is dropped, so candidate 2 is save_big at the user batch.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[_ok(0.40, "save_attn"), _ok(0.37, "save_big")],
+        canary_script=[(True, {"ok": True})],
+        args=_wrapper_args(batch=24),
+    )
+    assert rc == 0
+    assert rec["value"] == 0.40
     assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
+    assert calls["batches"] == [0, 0]  # no per-candidate override in play
 
 
 def test_environment_error_carries_last_banked(monkeypatch, capsys):
@@ -230,7 +249,7 @@ def test_structured_inner_error_is_relayed(monkeypatch, capsys):
              "error": "RuntimeError: boom", "attempts": 1}
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[(inner, "rc=1: RuntimeError")] * 4,
+        attempts_script=[(inner, "rc=1: RuntimeError")] * 5,
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 1
