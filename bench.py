@@ -711,17 +711,26 @@ def wrapper_main(args: argparse.Namespace) -> int:
         # attention last — a pathology in any one policy can cost bounded
         # attempts, never the round's number. The race reports the BEST
         # success, so `python bench.py` reproduces whichever rung wins.
+        # 4th field: contender (True = could be the best number, always
+        # raced) vs fallback (False = measured-slower safety rung, run only
+        # while no result is banked).
         candidates = [
-            ("save_attn", "", 0), ("none", "", 8),
-            ("save_big", "", 0), ("full", "", 0), ("full", "naive", 0),
+            ("save_attn", "", 0, True), ("none", "", 8, True),
+            ("save_big", "", 0, False), ("full", "", 0, False),
+            ("full", "naive", 0, False),
         ]
         if args.batch:
             # An explicit --batch is a series point the caller chose; a rung
-            # that would silently answer it at a different batch is dropped
+            # that would silently answer it at a DIFFERENT batch is dropped
             # (remat=none at a large explicit batch would only OOM anyway).
-            candidates = [c for c in candidates if not c[2]]
+            # A rung whose override equals the request stays — so a banked
+            # none@8 win is reproducible via `bench.py --batch 8`.
+            candidates = [
+                c for c in candidates if not c[2] or c[2] == args.batch
+            ]
     else:
-        candidates = [(args.remat, "", 0)]
+        candidates = [(args.remat, "", 0, True)]
+    last_contender = max(i for i, c in enumerate(candidates) if c[3])
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
     best = None
@@ -731,11 +740,21 @@ def wrapper_main(args: argparse.Namespace) -> int:
         "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
         "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
     )
-    for ci, (remat, attention, batch_over) in enumerate(candidates):
+    for ci, (remat, attention, batch_over, _contender) in enumerate(candidates):
         # Reserve budget up front: a pathological first candidate may spend
-        # at most its fair share, never the safe fallback's.
+        # at most its fair share, never the safe fallback's — but the share
+        # is floored at one full attempt (+margin) when the budget allows:
+        # adding fallback rungs must not shrink the HEADLINE rung's window
+        # below a legitimate TPU compile+run, whose mid-step kill is itself
+        # the wedge trigger (round-3 lesson).
         remaining = deadline - time.monotonic()
-        cand_deadline = time.monotonic() + remaining / (len(candidates) - ci)
+        share = remaining / (len(candidates) - ci)
+        if _contender:
+            # Floor CONTENDER rungs only: fallbacks keep strict fair-share,
+            # so cascading failures cannot geometrically starve the
+            # known-good tail below a viable attempt.
+            share = max(share, min(args.attempt_timeout + 60, remaining / 2))
+        cand_deadline = time.monotonic() + share
         backoff = 10.0
         cand_hangs = 0
         while True:
@@ -806,7 +825,16 @@ def wrapper_main(args: argparse.Namespace) -> int:
                 if cand_hangs >= 2:
                     break
                 continue
-            transient = any(m in err for m in transient_markers)
+            # OOM is DETERMINISTIC despite surfacing as RESOURCE_EXHAUSTED
+            # (XLA's allocator status code): retrying the identical compile
+            # can only drain the rung's budget share. The marginal probe
+            # rungs (remat=none ladder, mfu-1b b4) are sized to sometimes
+            # OOM — each must cost exactly one bounded attempt.
+            oom = any(m in err for m in (
+                "Out of memory", "out of memory", "OOM",
+                "Attempting to reserve",
+            ))
+            transient = not oom and any(m in err for m in transient_markers)
             if not transient:
                 break
             if time.monotonic() + backoff >= cand_deadline:
@@ -815,8 +843,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
             backoff = min(backoff * 2, 120.0)
         if wedged:
             break
-        if race and best is not None and ci >= 1:
-            break  # a success after the newest policy: later rungs are slower
+        if best is not None and ci >= last_contender:
+            break  # every contender has run: remaining fallbacks are slower
     if best is not None:
         if canary_info is not None:
             best.setdefault("canary_s", canary_info.get("canary_s"))

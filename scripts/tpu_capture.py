@@ -408,19 +408,28 @@ def _run_stages(args, on, gated, risky, py) -> None:
     if on("batch-sweep"):
         # remat=none points (store everything, ZERO recompute): analytic MFU
         # charges remat recompute as waste, so if the activations fit, the
-        # honest number jumps. CPU AOT memory analysis (r4), true peak =
-        # args + temps (outputs alias donated state): none/b4 ~8.8 GiB,
-        # none/b8 ~14.5 GiB (both fit v5e 16 GB; b12 ~20.3 GiB does not).
-        # XLA checkpoint policy is a proven class on this backend — same
-        # compile path as the measured remat points.
+        # honest number jumps. CPU AOT (true peak = args + temps) says
+        # none/b4 ~8.8 GiB, none/b8 ~14.5 GiB — but CPU AOT compiles NAIVE
+        # attention (materialized (T,T) scores the TPU flash kernel never
+        # allocates; its custom-VJP residuals are q/k/v/o/lse), so the TPU
+        # footprint is smaller still: the ladder probes up to b16. OOM
+        # raises cleanly — it cannot wedge. XLA checkpoint policy is a
+        # proven class on this backend (same compile path as the measured
+        # remat points).
+        # Proven-class knee points FIRST (bank-most-important-first: a
+        # short window must not close on speculative probes), then the
+        # none ladder ascending — each OOM costs one bounded attempt
+        # (bench.py classifies OOM as deterministic, never retried).
         for extra in (
             ["--remat", "save_attn", "--batch", "8"],
             ["--remat", "save_attn", "--batch", "12"],
             ["--remat", "save_attn", "--batch", "20"],
-            ["--remat", "none", "--batch", "4"],
-            ["--remat", "none", "--batch", "8"],
             ["--remat", "save_big", "--batch", "8"],
             ["--remat", "save_big", "--batch", "16"],
+            ["--remat", "none", "--batch", "4"],
+            ["--remat", "none", "--batch", "8"],
+            ["--remat", "none", "--batch", "12"],
+            ["--remat", "none", "--batch", "16"],
         ):
             gated(
                 "bsweep:" + "/".join(extra).replace("--", ""),

@@ -180,18 +180,51 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
 
 def test_explicit_batch_drops_override_rungs(monkeypatch, capsys):
     # `--batch 24` is a series point the caller chose; the race must not
-    # silently answer it with a batch-8 measurement (code-review r4). The
-    # none@8 rung is dropped, so candidate 2 is save_big at the user batch.
+    # silently answer it with a batch-8 measurement (code-review r4). With
+    # the none@8 rung dropped there is no second CONTENDER, so a first-rung
+    # success ends the race — the measured-slower save_big fallback must
+    # not burn hardware window that cannot improve the number.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.40, "save_attn"), _ok(0.37, "save_big")],
+        attempts_script=[_ok(0.40, "save_attn")],
         canary_script=[(True, {"ok": True})],
         args=_wrapper_args(batch=24),
     )
     assert rc == 0
     assert rec["value"] == 0.40
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_big"]
-    assert calls["batches"] == [0, 0]  # no per-candidate override in play
+    assert [r for r, _ in calls["attempts"]] == ["save_attn"]
+    assert calls["batches"] == [0]  # no per-candidate override in play
+
+
+def test_matching_explicit_batch_keeps_override_rung(monkeypatch, capsys):
+    # `--batch 8` equals the none rung's own batch: the rung stays, so a
+    # banked none@8 race win is reproducible at its explicit batch.
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[_ok(0.40, "save_attn"), _ok(0.52, "none")],
+        canary_script=[(True, {"ok": True})],
+        args=_wrapper_args(batch=8),
+    )
+    assert rc == 0
+    assert rec["value"] == 0.52
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
+
+
+def test_oom_is_deterministic_not_transient(monkeypatch, capsys):
+    # XLA OOM surfaces as RESOURCE_EXHAUSTED (a transient_markers match),
+    # but retrying the identical compile only drains the rung's budget
+    # share: one bounded attempt, then the next candidate (code-review r4).
+    oom = (None, "rc=1: XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory "
+                 "while trying to allocate 18.3GiB")
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[oom, _ok(0.41, "none")],
+        canary_script=[(True, {"ok": True})],
+    )
+    assert rc == 0
+    assert rec["value"] == 0.41
+    # Exactly ONE attempt on the OOM-ing candidate, no backoff retries.
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
 
 
 def test_environment_error_carries_last_banked(monkeypatch, capsys):
