@@ -94,6 +94,80 @@ def test_parity_rc0_without_delta_does_not_count(tmp_path):
     assert tpu_capture._critical_banked(str(out)) == set()
 
 
+def test_full_campaign_runs_criticals_first_and_defers_risky(
+        tmp_path, monkeypatch):
+    """Drive tpu_capture.main() end-to-end with stubbed stage execution:
+    the campaign must run mfu -> parity-tpu -> e2e before everything else,
+    and with all criticals succeeding the risky tier must RUN (not defer).
+    (The criticals-FAIL deferral path is pinned by the next test.)"""
+    out = tmp_path / "cap.jsonl"
+    ran = []
+
+    def fake_run_cmd(name, cmd, timeout, out_f, wait_pool=None):
+        ran.append(name)
+        rec = {"stage": name, "rc": 0}
+        if name == "parity-tpu":
+            rec.update(delta=0.0003, **{"pass": True})
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+        return rec
+
+    monkeypatch.setattr(tpu_capture, "run_cmd", fake_run_cmd)
+    monkeypatch.setattr(tpu_capture, "wait_for_backend",
+                        lambda out_f, pool: {"ok": True})
+    monkeypatch.setattr(
+        "sys.argv", ["tpu_capture.py", "--out", str(out)])
+    assert tpu_capture.main() == 0
+
+    # Priority order: the three criticals lead, in order.
+    assert ran[:3] == ["mfu", "parity-tpu", "e2e"]
+    # The risky tier RAN because the criticals banked.
+    for risky_stage in ("profile", "profile-decode", "decode-int8",
+                        "sweep-full"):
+        assert risky_stage in ran, f"{risky_stage} should have run"
+    # Risky stages come strictly after EVERY non-risky stage, whatever the
+    # non-risky ordering is.
+    def is_risky(s):
+        return s in tpu_capture.RISKY_STAGES or s.startswith("unroll")
+
+    first_risky = min(i for i, s in enumerate(ran) if is_risky(s))
+    last_nonrisky = max(i for i, s in enumerate(ran) if not is_risky(s))
+    assert first_risky > last_nonrisky
+
+
+def test_full_campaign_defers_risky_when_criticals_fail(
+        tmp_path, monkeypatch):
+    out = tmp_path / "cap.jsonl"
+    ran = []
+
+    def fake_run_cmd(name, cmd, timeout, out_f, wait_pool=None):
+        ran.append(name)
+        # Every stage fails (e.g. each inner run errors out).
+        rec = {"stage": name, "rc": 1, "error": "boom"}
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+        return rec
+
+    monkeypatch.setattr(tpu_capture, "run_cmd", fake_run_cmd)
+    monkeypatch.setattr(tpu_capture, "wait_for_backend",
+                        lambda out_f, pool: {"ok": True})
+    monkeypatch.setattr(
+        "sys.argv", ["tpu_capture.py", "--out", str(out)])
+    assert tpu_capture.main() == 0
+
+    # No risky stage may have executed...
+    for s in ran:
+        assert s not in tpu_capture.RISKY_STAGES
+        assert not s.startswith("unroll")
+    # ...and each deferral left a structured skip record.
+    recs = [json.loads(ln) for ln in open(out)]
+    deferred = [r for r in recs if r.get("skipped")]
+    assert {r["stage"] for r in deferred} >= {
+        "profile", "profile-decode", "decode-int8", "sweep-full"}
+    assert all("critical stages not yet banked" in r["error"]
+               for r in deferred)
+
+
 def test_missing_log_means_nothing_banked(tmp_path):
     assert tpu_capture._critical_banked(str(tmp_path / "absent.jsonl")) == set()
 
