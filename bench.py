@@ -86,7 +86,7 @@ def parse_args(argv=None) -> argparse.Namespace:
         "persistent cache, ~1.9x smaller at Dh=64)",
     )
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
-    parser.add_argument("--ce", default="", choices=["", "chunked", "fused"])
+    parser.add_argument("--ce", default="", choices=["", "chunked", "fused", "dense"])
     parser.add_argument(
         "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
     )

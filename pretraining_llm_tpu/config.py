@@ -81,7 +81,7 @@ class ModelConfig:
     # Pallas online-logsumexp kernel (ops/pallas_ce.py) — no logits ever
     # reach HBM. Fused silently degrades to chunked for biased or
     # tensor-sharded heads.
-    ce_impl: str = "chunked"  # chunked | fused
+    ce_impl: str = "chunked"  # chunked | fused | dense
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
     scan_unroll: int = 1
@@ -131,8 +131,10 @@ class ModelConfig:
             )
         if self.remat not in _REMAT_POLICIES:
             raise ValueError(f"remat must be one of {_REMAT_POLICIES}, got {self.remat!r}")
-        if self.ce_impl not in ("chunked", "fused"):
-            raise ValueError(f"ce_impl must be 'chunked' or 'fused', got {self.ce_impl!r}")
+        if self.ce_impl not in ("chunked", "fused", "dense"):
+            raise ValueError(
+                f"ce_impl must be 'chunked', 'fused' or 'dense', got {self.ce_impl!r}"
+            )
         if self.ring_layout not in ("contiguous", "zigzag"):
             raise ValueError(
                 f"ring_layout must be 'contiguous' or 'zigzag', got {self.ring_layout!r}"

@@ -600,14 +600,18 @@ def _chunked_ce(
     targets: jax.Array,
     cfg: ModelConfig,
 ) -> jax.Array:
-    """Mean cross-entropy without materializing the full (B*T, V) logits.
+    """Mean cross-entropy head dispatcher (chunked | fused | dense).
 
-    The fp32 logits for GPT-2-sized vocabs dwarf every other activation
-    (B=12, T=1024, V=50304 -> 2.5 GB); computing them whole, saving them for
-    backward, and re-reading them is pure HBM traffic. Instead scan over token
-    chunks under jax.checkpoint: each chunk's logits live only transiently,
-    and the backward recomputes them chunk-by-chunk (one extra small matmul
-    per chunk for a ~3x cut in head memory traffic).
+    chunked (default): no full (B*T, V) logits buffer. The fp32 logits for
+    GPT-2-sized vocabs dwarf every other activation (B=12, T=1024,
+    V=50304 -> 2.5 GB); computing them whole, saving them for backward, and
+    re-reading them is pure HBM traffic. Instead scan over token chunks:
+    each chunk's logits live only transiently, and the backward recomputes
+    them chunk-by-chunk (one extra small matmul per chunk for a ~3x cut in
+    head memory traffic). fused: Pallas kernel (see ops/pallas_ce).
+    dense: the OPPOSITE trade — deliberately materializes and SAVES the
+    compute-dtype (S, V) logits so backward recomputes nothing (see
+    _dense_lse_ce); head memory is S*V*2 bytes.
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     b, t, d = hidden.shape
@@ -668,6 +672,23 @@ def _chunked_ce(
                     hidden_c.reshape(s, d), w_c, targets.reshape(s)
                 )
             return jnp.mean(losses)
+    if cfg.ce_impl == "dense":
+        # ZERO-recompute head: the backward of the chunked path re-runs the
+        # (S, V) logits matmul (2*S*d*V FLOPs — ~10% of the whole step's
+        # analytic FLOPs at gpt2-124m/b16, pure unaccounted wall time),
+        # while this path SAVES compute-dtype logits (+ the f32 lse) and
+        # backward is just softmax + the two unavoidable grad matmuls.
+        # Cost: S*V*2 bytes of saved residual (824 MB at b8/T1024/V50304)
+        # — affordable exactly when remat pressure is low (small batch or
+        # remat=none), which is when the recompute charge dominates. Also
+        # removes the chunk scan's serialization. Numerics: backward's
+        # softmax is exp(bf16-rounded logits - lse) vs the chunked path's
+        # freshly recomputed f32-accum logits; grads agree to bf16 rounding
+        # (tested) — the forward LOSS value is computed from f32-accum
+        # logits either way and matches exactly.
+        return _dense_lse_ce(
+            hidden.reshape(s, d), w_out, bias, targets.reshape(s), cdt
+        ) / s
     # Chunk only when the fp32 logits buffer is big enough to matter (XLA
     # already fuses the small-head case well — measured neutral-to-slower to
     # chunk at GPT-2 batch sizes). Target <= ~512 MB per chunk.
@@ -699,6 +720,19 @@ def _chunked_ce(
     return _lse_saved_ce(xs, w_out, bias, ts_, cdt) / s
 
 
+def _head_logits32(xc, wc, bias, cdt):
+    """The ONE definition of head logits for both custom-VJP CE heads:
+    compute-dtype operands, f32 accumulation, f32 bias add. The chunked and
+    dense backward paths must stay numerically in lockstep — any change to
+    this formula applies to both."""
+    logits = jnp.einsum(
+        "sd,dv->sv", xc.astype(cdt), wc, preferred_element_type=jnp.float32
+    )
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
+
+
 def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
     """Sum of per-token CE over chunked logits, custom VJP.
 
@@ -717,12 +751,7 @@ def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
     sc = ts_.shape[1]
 
     def logits_of(xc, wc, bias):
-        logits = jnp.einsum(
-            "sd,dv->sv", xc.astype(cdt), wc, preferred_element_type=jnp.float32
-        )
-        if bias is not None:
-            logits = logits + bias.astype(jnp.float32)
-        return logits
+        return _head_logits32(xc, wc, bias, cdt)
 
     @jax.custom_vjp
     def ce(xs, w_out, bias):
@@ -773,6 +802,53 @@ def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
 
     ce.defvjp(_fwd, _bwd)
     return ce(xs, w_out, bias)
+
+
+def _dense_lse_ce(x, w_out, bias, ts_, cdt):
+    """Sum of per-token CE with SAVED logits — no backward recompute.
+
+    Custom VJP saving (compute-dtype logits, f32 lse): forward computes the
+    (S, V) logits once with f32 accumulation (loss value identical to the
+    chunked path), backward rebuilds softmax in one elementwise pass from
+    the saved block and goes straight to the dX/dW matmuls. The matmul the
+    chunked backward re-runs simply never happens again.
+    """
+    sc = ts_.shape[0]
+
+    @jax.custom_vjp
+    def ce(x, w_out, bias):
+        return _fwd(x, w_out, bias)[0]
+
+    def _fwd(x, w_out, bias):
+        logits = _head_logits32(x, w_out.astype(cdt), bias, cdt)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        label_logit = jnp.take_along_axis(logits, ts_[:, None], axis=-1)[:, 0]
+        total = jnp.sum(lse - label_logit)
+        # Save in compute dtype: halves the residual vs f32 at bf16-rounding
+        # cost in backward only (the fp32 loss above is already computed).
+        return total, (x, w_out, bias, logits.astype(cdt), lse)
+
+    def _bwd(res, g):
+        x, w_out, bias, logits_c, lse = res
+        p = jnp.exp(logits_c.astype(jnp.float32) - lse[:, None])
+        dlogits = (p.at[jnp.arange(sc), ts_].add(-1.0)) * g  # fp32
+        dx = jnp.einsum(
+            "sv,dv->sd", dlogits, w_out.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        dw = jnp.einsum(
+            "sd,sv->dv", x.astype(cdt), dlogits,
+            preferred_element_type=jnp.float32,
+        )
+        db = None if bias is None else jnp.sum(dlogits, axis=0)
+        return (
+            dx.astype(x.dtype),
+            dw.astype(w_out.dtype),
+            None if bias is None else db.astype(bias.dtype),
+        )
+
+    ce.defvjp(_fwd, _bwd)
+    return ce(x, w_out, bias)
 
 
 def loss_fn(

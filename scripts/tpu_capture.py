@@ -430,6 +430,13 @@ def _run_stages(args, on, gated, risky, py) -> None:
             ["--remat", "none", "--batch", "8"],
             ["--remat", "none", "--batch", "12"],
             ["--remat", "none", "--batch", "16"],
+            # ce=dense (saved-logits head, r4): removes the chunked CE
+            # backward's logits-matmul recompute (~10% of analytic step
+            # FLOPs) for S*V*2 bytes of saved residual — plain XLA einsums,
+            # same proven compile class as chunked.
+            ["--remat", "none", "--batch", "8", "--ce", "dense"],
+            ["--remat", "save_attn", "--batch", "16", "--ce", "dense"],
+            ["--remat", "save_attn", "--batch", "8", "--ce", "dense"],
         ):
             gated(
                 "bsweep:" + "/".join(extra).replace("--", ""),

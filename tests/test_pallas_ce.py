@@ -232,3 +232,99 @@ def test_lse_saved_chunked_matches_dense(with_bias):
             np.asarray(b).reshape(np.asarray(a).shape), np.asarray(a),
             rtol=2e-4, atol=2e-5,
         )
+
+
+# ---------------------------------------------------------------------------
+# The dense saved-logits head (ce_impl="dense": zero backward recompute)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_dense_lse_ce_matches_reference(with_bias):
+    """_dense_lse_ce (custom VJP saving compute-dtype logits + lse) ==
+    whole-logits autodiff CE, loss AND all gradients. At compute dtype f32
+    the saved logits are exact, so this pins the VJP math itself."""
+    from pretraining_llm_tpu.models.transformer import _dense_lse_ce
+
+    s, d, v = 64, 32, 160
+    h, w, labels = _inputs(jax.random.key(11), s=s, d=d, v=v)
+    bias = (jax.random.normal(jax.random.key(12), (v,)) * 0.2) if with_bias else None
+
+    def ref(h, w, bias):
+        logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+        return jnp.sum(lse - gold)
+
+    def dense_head(h, w, bias):
+        return _dense_lse_ce(h, w, bias, labels, jnp.float32)
+
+    argnums = (0, 1, 2) if with_bias else (0, 1)
+    l_ref, g_ref = jax.value_and_grad(ref, argnums=argnums)(h, w, bias)
+    l_new, g_new = jax.value_and_grad(dense_head, argnums=argnums)(h, w, bias)
+    np.testing.assert_allclose(float(l_new), float(l_ref), rtol=1e-5)
+    for a, b in zip(g_ref, g_new):
+        np.testing.assert_allclose(
+            np.asarray(b).reshape(np.asarray(a).shape), np.asarray(a),
+            rtol=2e-4, atol=2e-5,
+        )
+
+
+def test_model_loss_dense_matches_chunked():
+    """ce_impl='dense' through the whole model == the chunked head, loss
+    and gradients (fp32 compute: saved logits are exact)."""
+    import dataclasses
+
+    from pretraining_llm_tpu.config import ModelConfig
+    from pretraining_llm_tpu.models import transformer
+
+    cfg = ModelConfig(
+        vocab_size=96, context_length=32, d_model=32, n_heads=4, n_layers=2,
+        param_dtype="float32", compute_dtype="float32",
+    )
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    cfg_d = dataclasses.replace(cfg, ce_impl="dense")
+    l_c, g_c = jax.value_and_grad(transformer.loss_fn)(params, tokens, targets, cfg)
+    l_d, g_d = jax.value_and_grad(transformer.loss_fn)(params, tokens, targets, cfg_d)
+    np.testing.assert_allclose(float(l_d), float(l_c), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        g_c, g_d,
+    )
+
+
+def test_model_loss_dense_bf16_compute_close_to_chunked():
+    """At bf16 compute the dense backward reads bf16-rounded saved logits
+    where chunked recomputes f32-accum ones: grads agree to bf16 rounding."""
+    import dataclasses
+
+    from pretraining_llm_tpu.config import ModelConfig
+    from pretraining_llm_tpu.models import transformer
+
+    cfg = ModelConfig(
+        vocab_size=96, context_length=32, d_model=32, n_heads=4, n_layers=2,
+        param_dtype="float32", compute_dtype="bfloat16",
+    )
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    cfg_d = dataclasses.replace(cfg, ce_impl="dense")
+    l_c, g_c = jax.value_and_grad(transformer.loss_fn)(params, tokens, targets, cfg)
+    l_d, g_d = jax.value_and_grad(transformer.loss_fn)(params, tokens, targets, cfg_d)
+    # Forward loss is f32-accum logits both ways: tight.
+    np.testing.assert_allclose(float(l_d), float(l_c), rtol=1e-5)
+    # Gradients: bf16 logits rounding in the dense backward only.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3
+        ),
+        g_c, g_d,
+    )

@@ -100,6 +100,34 @@ def test_sharding_invariance(mesh8):
     )
 
 
+def test_sharding_invariance_dense_ce(mesh8):
+    """ce_impl='dense' (saved-logits head): 8-device sharded step == single
+    device — the custom VJP's einsums and the (B,T)->(S,) reshape must
+    compose through GSPMD exactly like the chunked scan does."""
+    cfg = _tiny_config(train_steps=2, batch_size=8).with_overrides(
+        {"model.compute_dtype": "float32", "model.ce_impl": "dense"}
+    )
+    state_a = ts.init_train_state(cfg, jax.random.key(0))
+    state_b = ts.init_train_state(cfg, jax.random.key(0))
+    step_single = ts.build_train_step(cfg, mesh=None)
+    step_mesh = ts.build_train_step(cfg, mesh=mesh8)
+    state_b = ts.shard_train_state(state_b, mesh8)
+    it = _batch(cfg)
+    for _ in range(2):
+        x, y = next(it)
+        batch = (jnp.asarray(x), jnp.asarray(y))
+        state_a, ma = step_single(state_a, batch)
+        state_b, mb = step_mesh(state_b, batch)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-3, atol=1e-4
+        ),
+        state_a["params"],
+        state_b["params"],
+    )
+
+
 def test_fsdp_actually_shards_params(mesh8):
     cfg = _tiny_config()
     state = ts.init_train_state(cfg, jax.random.key(0))
