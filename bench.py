@@ -596,12 +596,15 @@ def _run_canary(timeout: float):
 
 
 def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: str = "",
-             batch_override: int = 0):
+             batch_override: int = 0, ce_override: str = ""):
     """One fresh-subprocess inner run. Returns (json_dict|None, err_str).
 
     ``batch_override``: per-candidate batch for race rungs whose measured
     best lives at a different batch than the preset default (e.g.
     remat=none fits only at small batch); 0 = use args.batch.
+    ``ce_override``: per-candidate CE head (e.g. the none@8+dense rung);
+    "" = use args.ce. The race drops ce-overridden rungs when an explicit
+    --ce is given, so a nonempty ce_override never coexists with args.ce.
     """
     cmd = [
         sys.executable, os.path.abspath(__file__), "--_inner",
@@ -622,8 +625,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--kv-dtype", args.kv_dtype]
     if args.attention or attention:
         cmd += ["--attention", args.attention or attention]
-    if args.ce:
-        cmd += ["--ce", args.ce]
+    if args.ce or ce_override:
+        cmd += ["--ce", ce_override or args.ce]
     if remat:
         cmd += ["--remat", remat]
     if args.optimizer:
@@ -711,13 +714,19 @@ def wrapper_main(args: argparse.Namespace) -> int:
         # attention last — a pathology in any one policy can cost bounded
         # attempts, never the round's number. The race reports the BEST
         # success, so `python bench.py` reproduces whichever rung wins.
-        # 4th field: contender (True = could be the best number, always
-        # raced) vs fallback (False = measured-slower safety rung, run only
-        # while no result is banked).
+        # Fields: (remat, attention, batch_override, ce_override,
+        # contender). Contenders (could be the best number) are always
+        # raced; fallbacks (measured-slower safety rungs) run only while no
+        # result is banked. none@8+dense is the analytic projection of the
+        # >=50% bar: zero block recompute AND zero CE-logits recompute;
+        # none@8+chunked backs it up in case the dense head has an
+        # unexpected pathology at this shape.
         candidates = [
-            ("save_attn", "", 0, True), ("none", "", 8, True),
-            ("save_big", "", 0, False), ("full", "", 0, False),
-            ("full", "naive", 0, False),
+            ("save_attn", "", 0, "", True),
+            ("none", "", 8, "dense", True),
+            ("none", "", 8, "", True),
+            ("save_big", "", 0, "", False), ("full", "", 0, "", False),
+            ("full", "naive", 0, "", False),
         ]
         if args.batch:
             # An explicit --batch is a series point the caller chose; a rung
@@ -728,9 +737,15 @@ def wrapper_main(args: argparse.Namespace) -> int:
             candidates = [
                 c for c in candidates if not c[2] or c[2] == args.batch
             ]
+        if args.ce:
+            # An explicit --ce applies to EVERY rung (the plain rungs all
+            # inherit it), so a ce-overridden rung is either a duplicate of
+            # its plain sibling (--ce dense) or a mislabeled contradiction
+            # of the caller's choice (--ce chunked/fused): drop them all.
+            candidates = [c for c in candidates if not c[3]]
     else:
-        candidates = [(args.remat, "", 0, True)]
-    last_contender = max(i for i, c in enumerate(candidates) if c[3])
+        candidates = [(args.remat, "", 0, "", True)]
+    last_contender = max(i for i, c in enumerate(candidates) if c[4])
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
     best = None
@@ -740,7 +755,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
         "UNAVAILABLE", "DEADLINE", "unavailable", "backend",
         "Socket", "socket", "connect", "RESOURCE_EXHAUSTED",
     )
-    for ci, (remat, attention, batch_over, _contender) in enumerate(candidates):
+    for ci, (remat, attention, batch_over, ce_over, _contender) in enumerate(candidates):
         # Reserve budget up front: a pathological first candidate may spend
         # at most its fair share, never the safe fallback's — but the share
         # is floored at one full attempt (+margin) when the budget allows:
@@ -763,7 +778,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
                 break
             attempts += 1
             rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining), attention,
-                                batch_over)
+                                batch_over, ce_over)
             if rec is not None and not err:
                 if best is None or rec.get("value", 0) > best.get("value", 0):
                     best = rec
@@ -772,6 +787,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
                 f"attempt {attempts} (remat={remat or 'default'}"
                 + (f", attention={attention}" if attention else "")
                 + (f", batch={batch_over}" if batch_over else "")
+                + (f", ce={ce_over}" if ce_over else "")
                 + f"): {err}"
             )
             if rec is not None:

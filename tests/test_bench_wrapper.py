@@ -55,10 +55,12 @@ def _run(monkeypatch, capsys, attempts_script, canary_script, args=None):
     monkeypatch.setattr(bench, "time", ft)
     calls = {"attempts": [], "canaries": 0}
 
-    def fake_attempt(a, remat, timeout, attention="", batch_override=0):
+    def fake_attempt(a, remat, timeout, attention="", batch_override=0,
+                     ce_override=""):
         rec, err = attempts_script.pop(0)
         calls["attempts"].append((remat, attention))
         calls.setdefault("batches", []).append(batch_override)
+        calls.setdefault("ces", []).append(ce_override)
         ft.sleep(timeout if "hung" in err else 5.0)
         return rec, err
 
@@ -89,12 +91,16 @@ def test_hang_with_live_canary_moves_to_next_candidate(monkeypatch, capsys):
     # the problem; candidate 2 succeeds and is reported.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.41, "save_big")],
+        attempts_script=[HUNG, _ok(0.41, "save_big"), _ok(0.39, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none", "none"]
+    # The none rungs reach the inner run at THEIR batch and CE head
+    # (dense projection first, chunked backup second).
+    assert calls["batches"] == [0, 8, 8]
+    assert calls["ces"] == ["", "dense", ""]
     assert calls["canaries"] == 1  # exactly one cheap probe after the hang
 
 
@@ -122,14 +128,15 @@ def test_wedged_then_recovered_retries_same_candidate(monkeypatch, capsys):
     # min(attempt_timeout, share), so share > 2*attempt_timeout + polls.)
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.40, "save_attn"), _ok(0.38, "save_big")],
+        attempts_script=[HUNG, _ok(0.40, "save_attn"), _ok(0.38, "save_big"),
+                        _ok(0.37, "none")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
-        args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
+        args=_wrapper_args(timeout_budget=2600, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.40  # best of the race, from the retried candidate
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none"]
+        "save_attn", "save_attn", "none", "none"]
 
 
 def test_double_hang_abandons_candidate(monkeypatch, capsys):
@@ -138,14 +145,14 @@ def test_double_hang_abandons_candidate(monkeypatch, capsys):
     # time.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, HUNG, _ok(0.39, "save_big")],
+        attempts_script=[HUNG, HUNG, _ok(0.39, "save_big"), _ok(0.36, "none")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
-        args=_wrapper_args(timeout_budget=2000, attempt_timeout=150),
+        args=_wrapper_args(timeout_budget=2600, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.39
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none"]
+        "save_attn", "save_attn", "none", "none"]
 
 
 def test_wedge_with_banked_result_reports_it_immediately(monkeypatch, capsys):
@@ -168,14 +175,14 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
     # tail is never run (budget preserved).
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.41, "save_attn"), _ok(0.30, "save_big")],
+        attempts_script=[_ok(0.41, "save_attn"), _ok(0.30, "save_big"),
+                        _ok(0.28, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
-    # The remat=none rung must reach the inner run at ITS measured batch.
-    assert calls["batches"] == [0, 8]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none", "none"]
+    assert calls["batches"] == [0, 8, 8]
 
 
 def test_explicit_batch_drops_override_rungs(monkeypatch, capsys):
@@ -201,13 +208,30 @@ def test_matching_explicit_batch_keeps_override_rung(monkeypatch, capsys):
     # banked none@8 race win is reproducible at its explicit batch.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.40, "save_attn"), _ok(0.52, "none")],
+        attempts_script=[_ok(0.40, "save_attn"), _ok(0.52, "none"),
+                        _ok(0.50, "none")],
         canary_script=[(True, {"ok": True})],
         args=_wrapper_args(batch=8),
     )
     assert rc == 0
     assert rec["value"] == 0.52
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none", "none"]
+
+
+def test_explicit_ce_drops_override_rungs(monkeypatch, capsys):
+    # `--ce chunked` applies to every rung; the dense-overridden rung would
+    # be a duplicate of its plain sibling (or a contradiction of the
+    # caller's choice) and must not burn a contender share (code-review r4).
+    rc, rec, calls = _run(
+        monkeypatch, capsys,
+        attempts_script=[_ok(0.40, "save_attn"), _ok(0.38, "none")],
+        canary_script=[(True, {"ok": True})],
+        args=_wrapper_args(ce="chunked"),
+    )
+    assert rc == 0
+    assert rec["value"] == 0.40
     assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
+    assert calls["ces"] == ["", ""]  # no per-candidate CE override in play
 
 
 def test_oom_is_deterministic_not_transient(monkeypatch, capsys):
@@ -218,13 +242,13 @@ def test_oom_is_deterministic_not_transient(monkeypatch, capsys):
                  "while trying to allocate 18.3GiB")
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[oom, _ok(0.41, "none")],
+        attempts_script=[oom, _ok(0.41, "none"), _ok(0.40, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
     # Exactly ONE attempt on the OOM-ing candidate, no backoff retries.
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none"]
+    assert [r for r, _ in calls["attempts"]] == ["save_attn", "none", "none"]
 
 
 def test_environment_error_carries_last_banked(monkeypatch, capsys):
@@ -282,7 +306,7 @@ def test_structured_inner_error_is_relayed(monkeypatch, capsys):
              "error": "RuntimeError: boom", "attempts": 1}
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[(inner, "rc=1: RuntimeError")] * 5,
+        attempts_script=[(inner, "rc=1: RuntimeError")] * 6,
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 1
