@@ -76,11 +76,14 @@ class ModelConfig:
     # Rematerialization policy applied to each scanned block — see
     # ops/remat.py for what each saves.
     remat: str = "none"  # none | full | dots_saveable | save_attn | save_qkv_attn | save_big
-    # CE head implementation: "chunked" scans token chunks under remat
-    # (default, handles bias + vocab-sharded TP heads); "fused" runs the
-    # Pallas online-logsumexp kernel (ops/pallas_ce.py) — no logits ever
-    # reach HBM. Fused silently degrades to chunked for biased or
-    # tensor-sharded heads.
+    # CE head implementation: "chunked" scans token chunks, backward
+    # recomputes each chunk's logits (default; handles bias + vocab-sharded
+    # TP heads); "fused" runs the Pallas online-logsumexp kernel
+    # (ops/pallas_ce.py) — no logits ever reach HBM, degrades loudly to
+    # chunked for biased or tensor-sharded heads; "dense" SAVES the
+    # compute-dtype logits so backward recomputes nothing — S*V*2 bytes of
+    # head memory for zero recompute FLOPs (the right trade at small batch
+    # or remat="none").
     ce_impl: str = "chunked"  # chunked | fused | dense
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
