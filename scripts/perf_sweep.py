@@ -27,20 +27,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The grid: remat policies x CE head x batch. Attention stays flash (naive
 # is only a reference point; measured 25% vs 41% MFU).
 GRID = {
-    "remat": ["save_attn", "save_qkv_attn", "save_big", "full"],
-    "ce": ["chunked", "fused"],
+    "remat": ["none", "save_attn", "save_qkv_attn", "save_big", "full"],
+    "ce": ["chunked", "fused", "dense"],
     "batch": [8, 12, 16, 24, 32],
 }
 
-# Measured on-chip 2026-07-31: save_attn + fused CE hangs the device after
-# warmup, twice reproducibly, and killing the hung client wedges the
-# backend for HOURS (the round-2 0.0 mechanism). A sweep must never probe
-# a known wedge-class combo — the rest of the grid would be unreachable.
-EXCLUDE = [{"remat": "save_attn", "ce": "fused"}]
+# Excluded combos, each with the reason the skip log prints. Two classes:
+# wedge risk (a known or adjacent chip-wedge combo: probing one can cost
+# the backend for HOURS — the round-2 0.0 mechanism) and capacity (points
+# far past the AOT-estimated memory ceiling; OOM is a clean bounded
+# failure, but the budget is better spent on points that can land).
+EXCLUDE = [
+    ({"remat": "save_attn", "ce": "fused"},
+     "known chip-wedge combo (hung the device twice on-chip 2026-07-31)"),
+    # none+fused: a NEVER-probed fused-kernel combo (the wedge class was a
+    # fused combo) whose payoff is known-low — fused CE already measured a
+    # loss at this model shape. Not worth the wedge exposure.
+    ({"remat": "none", "ce": "fused"},
+     "unproven fused-kernel combo, known-low payoff: wedge exposure"),
+    ({"remat": "none", "batch": 24},
+     "far past the remat=none memory ceiling (AOT r4): near-certain OOM"),
+    ({"remat": "none", "batch": 32},
+     "far past the remat=none memory ceiling (AOT r4): near-certain OOM"),
+]
 
 
-def _excluded(flags: dict) -> bool:
-    return any(all(flags.get(k) == v for k, v in ex.items()) for ex in EXCLUDE)
+def _excluded(flags: dict) -> str:
+    """The exclusion reason for this combo, or '' if it should be probed."""
+    for ex, why in EXCLUDE:
+        if all(flags.get(k) == v for k, v in ex.items()):
+            return why
+    return ""
 
 
 def run_one(
@@ -95,10 +112,10 @@ def main() -> None:
     combos = [
         dict(zip(GRID, vals)) for vals in itertools.product(*GRID.values())
     ]
-    skipped = [c for c in combos if _excluded(c)]
+    skipped = [(c, _excluded(c)) for c in combos if _excluded(c)]
     combos = [c for c in combos if not _excluded(c)]
-    for c in skipped:
-        print(f"[skip] {c}: known chip-wedge combo (see EXCLUDE)", flush=True)
+    for c, why in skipped:
+        print(f"[skip] {c}: {why}", flush=True)
     results = []
     with open(args.out, "a") as f:
         env_alive = False
