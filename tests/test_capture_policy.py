@@ -199,3 +199,24 @@ def test_annotated_parity_record_does_not_count(tmp_path):
          "error": "superseded: spurious step-count mismatch"},
     ])
     assert tpu_capture._critical_banked(str(out)) == set()
+
+
+def test_perf_sweep_never_probes_wedge_combos():
+    """The sweep grid must filter every known/adjacent wedge-class combo
+    and the provably-over-ceiling capacity points, with honest reasons."""
+    import itertools
+
+    perf_sweep = importlib.import_module("perf_sweep")
+    combos = [dict(zip(perf_sweep.GRID, v))
+              for v in itertools.product(*perf_sweep.GRID.values())]
+    probed = [c for c in combos if not perf_sweep._excluded(c)]
+    # The on-chip-measured wedge combo and the adjacent unproven class:
+    for c in probed:
+        assert not (c["remat"] == "save_attn" and c["ce"] == "fused")
+        assert not (c["remat"] == "none" and c["ce"] == "fused")
+        assert not (c["remat"] == "none" and c["batch"] > 16)
+    # Reasons are per-exclusion and distinguish wedge from capacity.
+    assert "wedge" in perf_sweep._excluded(
+        {"remat": "save_attn", "ce": "fused", "batch": 8})
+    assert "OOM" in perf_sweep._excluded(
+        {"remat": "none", "ce": "chunked", "batch": 32})
