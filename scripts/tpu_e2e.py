@@ -13,10 +13,13 @@ virtual CPU mesh — on the real chip, through the real CLIs:
   3. A second `scripts/train.py` invocation RESUMES from that checkpoint
      (same command line — resume is the default) and trains to completion.
   4. `scripts/evaluate.py` loads the final checkpoint and reports val loss.
+  5. `scripts/generate_text.py` decodes from the final checkpoint — the
+     trained model must SERVE, completing the reference user journey.
 
 Emits ONE JSON line: preemption step, resume step, final/eval losses, and
 pass/fail checks (resumed from the preemption checkpoint; loss fell vs
-init ln(256); eval loss finite and sane). Exit 0 iff every check passes.
+init ln(256); eval loss finite and sane; the final checkpoint decodes
+tokens through generate_text). Exit 0 iff every check passes.
 
 Usage:  python scripts/tpu_e2e.py [--steps 300] [--out-dir DIR]
 """
@@ -203,6 +206,32 @@ def main() -> int:
         print(json.dumps({**result, "error": "phase3: evaluate hung"}))
         return 1
 
+    # --- Phase 4: generation from the final checkpoint (the reference
+    # user journey ends with generate_text; the operational story must
+    # prove the trained checkpoint actually SERVES, not just evaluates) --
+    try:
+        # stderr goes to its own file, NOT merged: a JAX/absl warning line
+        # on the merged stream would satisfy the generated-length check
+        # with zero tokens actually decoded.
+        with open(os.path.join(work, "phase4.stderr"), "w") as err4:
+            gen = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "generate_text.py"),
+                 "--model_path", ckpt_dir, "--input_text", "The ",
+                 "--max_new_tokens", "48", "--temperature", "0"],
+                stdout=subprocess.PIPE, stderr=err4, cwd=REPO,
+                timeout=args.phase_timeout, text=True)
+        # rstrip newlines ONLY: a briefly-trained byte model legitimately
+        # greedy-decodes whitespace (spaces are the most common byte), and
+        # the check is "decode ran and produced tokens", not text quality.
+        gen_out = (gen.stdout or "").rstrip("\r\n")
+        result["generate_rc"] = gen.returncode
+        result["generated_chars"] = len(gen_out)
+        result["generated_tail"] = gen_out[-80:]
+    except subprocess.TimeoutExpired:
+        print(json.dumps({**result, "error": "phase4: generate hung"}))
+        return 1
+
     # --- Checks --------------------------------------------------------
     import math
     eval_loss = result.get("eval", {}).get("val_loss")
@@ -220,6 +249,9 @@ def main() -> int:
         "eval_sane": (
             isinstance(eval_loss, (int, float))
             and eval_loss == eval_loss and eval_loss < math.log(256.0)),
+        "generates_text": (
+            result.get("generate_rc") == 0
+            and result.get("generated_chars", 0) > len("The ")),
     }
     result["checks"] = checks
     result["ok"] = all(checks.values())
