@@ -450,9 +450,14 @@ def _run_stages(args, on, gated, risky, py) -> None:
     # Llama-style 1B (config #4) at a batch its optimizer state + remat
     # leave room for. OOM raises cleanly — it cannot wedge the chip.
     if on("mfu-350m"):
-        for extra in ([], ["--batch", "16"]):
+        # b16+dense: saved logits ~1.65 GB on top of the ~12.8 GiB b16
+        # footprint — fits; the zero-recompute CE head is where the larger
+        # models' MFU is most attainable too.
+        for extra in ([], ["--batch", "16"],
+                      ["--batch", "16", "--ce", "dense"]):
             gated(
-                "mfu-350m" + ("/b16" if extra else ""),
+                "mfu-350m" + ("/" + "/".join(extra).replace("--", "")
+                              if extra else ""),
                 [py, BENCH, "--skip-canary", "--preset", "gpt2-350m-dp",
                  "--remat", "save_attn", "--timeout-budget", "800"] + extra,
                 920,
