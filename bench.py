@@ -749,6 +749,7 @@ def wrapper_main(args: argparse.Namespace) -> int:
     attempts = 0
     last_err = "no attempts made (timeout budget too small?)"
     best = None
+    rungs = []
     last_error_rec = None
     wedged = False
     transient_markers = (
@@ -780,6 +781,22 @@ def wrapper_main(args: argparse.Namespace) -> int:
             rec, err = _attempt(args, remat, min(args.attempt_timeout, remaining), attention,
                                 batch_over, ce_over)
             if rec is not None and not err:
+                # Per-rung evidence: the final JSON carries only the winner,
+                # so losing rungs' measurements would be unrecoverable from a
+                # campaign log (round-4 lesson: the remat=none contenders ran
+                # clean but their values vanished). Collected onto the
+                # winner's "rungs" list, which flows into the campaign JSONL.
+                print(
+                    "[bench] rung "
+                    f"remat={rec.get('remat')} ce={rec.get('ce_impl')} "
+                    f"batch={rec.get('batch')} -> "
+                    f"mfu={rec.get('value')} tok/s={rec.get('tokens_per_sec_chip')} "
+                    f"step_ms={rec.get('step_ms')}",
+                    file=sys.stderr,
+                )
+                rungs.append({k: rec.get(k) for k in (
+                    "remat", "ce_impl", "batch", "value",
+                    "tokens_per_sec_chip", "step_ms")})
                 if best is None or rec.get("value", 0) > best.get("value", 0):
                     best = rec
                 break  # this candidate succeeded; next candidate
@@ -864,6 +881,8 @@ def wrapper_main(args: argparse.Namespace) -> int:
     if best is not None:
         if canary_info is not None:
             best.setdefault("canary_s", canary_info.get("canary_s"))
+        if len(rungs) > 1:
+            best["rungs"] = rungs
         print(json.dumps(best))
         return 0
     if last_error_rec is not None and not wedged:

@@ -385,10 +385,29 @@ def main():
             return 2
 
     if args.only in ("", "jax"):
-        results["jax"] = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
+        new_jax = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
+        # A rerun on a DIFFERENT backend must not destroy the banked
+        # record: the TPU pinned-precision capture is round evidence
+        # (BASELINE.md parity table), and a casual CPU rerun would
+        # silently overwrite it. Archive the displaced record under a
+        # backend-suffixed key (the pattern jax_tpu_fastmatmul/jax_cpu
+        # already follow).
+        old_jax = results.get("jax")
+        if old_jax and old_jax.get("backend") != new_jax.get("backend"):
+            # Collision-safe: an existing archive (e.g. the banked
+            # jax_cpu baseline) must never itself be overwritten.
+            key = f"jax_{old_jax.get('backend', 'prev')}"
+            n = 2
+            while key in results:
+                key = f"jax_{old_jax.get('backend', 'prev')}_{n}"
+                n += 1
+            results[key] = old_jax
+        results["jax"] = new_jax
     if args.only in ("", "torch"):
         results["torch"] = run_torch(args, model_cfg, train_bin, val_bin, init_npz)
-    json.dump(results, open(results_path, "w"), indent=2)
+    with open(results_path, "w") as fh:
+        json.dump(results, fh, indent=2)
+        fh.write("\n")
 
     if "jax" in results and "torch" in results:
         sj, sj_exact = _steps_of(results["jax"])

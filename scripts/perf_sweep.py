@@ -38,13 +38,16 @@ GRID = {
 # far past the AOT-estimated memory ceiling; OOM is a clean bounded
 # failure, but the budget is better spent on points that can land).
 EXCLUDE = [
-    ({"remat": "save_attn", "ce": "fused"},
-     "known chip-wedge combo (hung the device twice on-chip 2026-07-31)"),
-    # none+fused: a NEVER-probed fused-kernel combo (the wedge class was a
-    # fused combo) whose payoff is known-low — fused CE already measured a
-    # loss at this model shape. Not worth the wedge exposure.
-    ({"remat": "none", "ce": "fused"},
-     "unproven fused-kernel combo, known-low payoff: wedge exposure"),
+    # fused CE is a WEDGE CLASS on this backend, not a single bad combo:
+    # save_attn+fused hung the chip twice (2026-07-31), and save_big+fused
+    # — which had TWO clean captures in round 3 — hung and wedged the
+    # backend on 2026-08-01. The wedge is intermittent within the class,
+    # so no fused point is safe to probe on-chip; fused CE also measured
+    # a throughput LOSS at every shape it completed (BASELINE.md), so the
+    # payoff is known-negative.
+    ({"ce": "fused"},
+     "fused-CE wedge class (hung save_attn twice 2026-07-31 and save_big "
+     "2026-08-01 despite two prior clean captures); measured slower anyway"),
     ({"remat": "none", "batch": 24},
      "far past the remat=none memory ceiling (AOT r4): near-certain OOM"),
     ({"remat": "none", "batch": 32},

@@ -210,13 +210,16 @@ def test_perf_sweep_never_probes_wedge_combos():
     combos = [dict(zip(perf_sweep.GRID, v))
               for v in itertools.product(*perf_sweep.GRID.values())]
     probed = [c for c in combos if not perf_sweep._excluded(c)]
-    # The on-chip-measured wedge combo and the adjacent unproven class:
+    # fused CE is excluded as an entire class (save_attn+fused hung twice
+    # round 3; save_big+fused hung round 4 despite two prior clean
+    # captures — the wedge is intermittent within the class):
     for c in probed:
-        assert not (c["remat"] == "save_attn" and c["ce"] == "fused")
-        assert not (c["remat"] == "none" and c["ce"] == "fused")
+        assert c["ce"] != "fused"
         assert not (c["remat"] == "none" and c["batch"] > 16)
     # Reasons are per-exclusion and distinguish wedge from capacity.
     assert "wedge" in perf_sweep._excluded(
         {"remat": "save_attn", "ce": "fused", "batch": 8})
+    assert "wedge" in perf_sweep._excluded(
+        {"remat": "save_big", "ce": "fused", "batch": 24})
     assert "OOM" in perf_sweep._excluded(
         {"remat": "none", "ce": "chunked", "batch": 32})
