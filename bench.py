@@ -92,6 +92,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
+        "--decode-unroll", action="store_true",
+        help="decode mode: fully unroll the depth scan for single-token "
+        "steps (decode_unroll_layers=True) — removes the inner while loop "
+        "whose boundary copies the whole KV cache every step (AOT-measured "
+        "~140 MB/step at gpt2-124m b8). Unproven kernel-config class on "
+        "this backend; probe via the risky capture tier only.",
+    )
+    parser.add_argument(
         "--block-q", type=int, default=0,
         help="flash kernel q-block override (0 = auto). WARNING: measured "
         "2026-07-31 on the axon v5e backend, 512x512 blocks at T=1024 HUNG "
@@ -198,6 +206,8 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         )
     if args.kv_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+    if args.decode_unroll:
+        cfg = dataclasses.replace(cfg, decode_unroll_layers=True)
     batch = args.batch or 8
     if args.quick:
         batch = min(batch, 4)
@@ -253,6 +263,9 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         rec["prompt_lengths"] = [int(x) for x in lengths]
     if cfg.kv_cache_dtype == "int8":
         rec["metric"] += "_kvint8"  # distinct series vs the bf16-cache baseline
+    if cfg.decode_unroll_layers:
+        rec["metric"] += "_unroll"  # distinct series vs the rolled-scan baseline
+        rec["decode_unroll_layers"] = True
     return rec
 
 
@@ -501,6 +514,8 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
             metric += "_ragged"
         if args.kv_dtype == "int8":
             metric += "_kvint8"
+        if args.decode_unroll:
+            metric += "_unroll"
     elif args.mode == "trainer":
         metric, unit = f"trainer_tokens_per_sec_{args.preset}", "tokens_per_sec_chip"
     else:
@@ -623,6 +638,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd.append("--ragged")
     if args.kv_dtype:
         cmd += ["--kv-dtype", args.kv_dtype]
+    if args.decode_unroll:
+        cmd.append("--decode-unroll")
     if args.attention or attention:
         cmd += ["--attention", args.attention or attention]
     if args.ce or ce_override:

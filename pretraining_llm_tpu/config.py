@@ -88,6 +88,17 @@ class ModelConfig:
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
     scan_unroll: int = 1
+    # Fully unroll the depth scan for SINGLE-TOKEN cached decode steps.
+    # The rolled layer scan nests a while loop inside the token-decode scan,
+    # and XLA inserts full-cache copies at the loop boundary every decode
+    # step (measured via AOT HLO: 4 cache-shaped copies/step at gpt2-124m
+    # b8/320 slots — ~140 MB/step of pure copy traffic — plus ~110 MB temp;
+    # unrolling removes the inner loop and ALL cache copies, letting the
+    # token scan update the cache in place). Decode-only: prefill (Tq>1)
+    # and training keep scan_unroll. Default off until measured on-chip —
+    # scan-unroll is an unproven kernel-config class on this backend
+    # (tpu_capture RISKY_STAGES).
+    decode_unroll_layers: bool = False
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
     # Mixture-of-experts MLP (0 = dense). Experts shard over the 'expert' mesh

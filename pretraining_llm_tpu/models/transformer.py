@@ -566,9 +566,19 @@ def forward(
         )
         new_cache = None
     else:
+        # Single-token decode steps may fully unroll the depth scan: the
+        # rolled inner while forces XLA to copy the whole cache at the
+        # token-scan loop boundary every step (see ModelConfig.
+        # decode_unroll_layers). Tq is a static shape, so this is a
+        # trace-time choice; prefill (Tq>1) keeps the rolled scan.
+        unroll = (
+            cfg.n_layers
+            if cfg.decode_unroll_layers and x.shape[1] == 1
+            else cfg.scan_unroll
+        )
         (x, aux_total), new_cache = jax.lax.scan(
             body, (x, aux0), (params["blocks"], kv_cache),
-            unroll=cfg.scan_unroll,
+            unroll=unroll,
         )
 
     x = layers.apply_norm(cfg.norm, params["final_norm"], x, cfg.norm_eps)

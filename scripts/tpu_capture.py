@@ -61,7 +61,8 @@ CRITICAL_STAGES = ("mfu", "parity-tpu", "e2e")
 # overrides) — and a wedge costs the rest of the hardware window, so these
 # may only run once every critical record is banked.
 RISKY_STAGES = frozenset(
-    {"profile", "profile-decode", "decode-int8", "unroll-sweep", "sweep-full"}
+    {"profile", "profile-decode", "decode-int8", "decode-unroll",
+     "unroll-sweep", "sweep-full"}
 )
 
 
@@ -254,7 +255,8 @@ def main() -> int:
         "(manual debugging only — this is how two rounds lost their number)")
     args = ap.parse_args()
     KNOWN = {
-        "mfu", "sweep-top", "decode", "decode-int8", "ctx8k", "trainer",
+        "mfu", "sweep-top", "decode", "decode-int8", "decode-unroll",
+        "ctx8k", "trainer",
         "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
         "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
     }
@@ -576,6 +578,18 @@ def _run_stages(args, on, gated, risky, py) -> None:
             "decode-int8",
             [py, BENCH, "--skip-canary", "--mode", "decode",
              "--kv-dtype", "int8"], 900,
+        )
+
+    # 9c'. Decode with the depth scan fully unrolled: removes the inner
+    # while loop whose boundary copies the whole KV cache every decode
+    # step (AOT HLO: 4 cache-shaped copies/step -> 0 at gpt2-124m b8;
+    # decode roofline hypothesis 1). Scan-unroll is an unproven compile
+    # class on this backend — risky tier.
+    if on("decode-unroll"):
+        risky(
+            "decode-unroll",
+            [py, BENCH, "--skip-canary", "--mode", "decode",
+             "--decode-unroll"], 900,
         )
 
     # 9d. Layer-scan unroll at the winning config: unrolling trades

@@ -266,6 +266,19 @@ def test_generate_flash_equals_naive_greedy(params):
     np.testing.assert_array_equal(got_n, got_f)
 
 
+def test_generate_decode_unroll_equals_rolled_greedy(params):
+    """decode_unroll_layers only changes the compiled loop structure (no
+    inner while -> no per-step cache copies); greedy output must be
+    bit-identical to the rolled depth scan."""
+    cfg_unroll = dataclasses.replace(CFG, decode_unroll_layers=True)
+    prompt = jax.random.randint(jax.random.key(16), (2, 8), 0, CFG.vocab_size)
+    got_r = np.asarray(generate(params, CFG, prompt, 8, jax.random.key(7), temperature=0.0))
+    got_u = np.asarray(
+        generate(params, cfg_unroll, prompt, 8, jax.random.key(7), temperature=0.0)
+    )
+    np.testing.assert_array_equal(got_r, got_u)
+
+
 @pytest.mark.parametrize(
     "pos,impl",
     [("learned", "naive"), ("rope", "naive"), ("rope", "flash")],
