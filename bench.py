@@ -76,7 +76,7 @@ def parse_args(argv=None) -> argparse.Namespace:
         "(one lockstep ragged program)",
     )
     parser.add_argument(
-        "--optimizer", default="", choices=["", "adamw", "adafactor"],
+        "--optimizer", default="", choices=["", "adamw", "adafactor", "muon"],
         help="train mode: optimizer override (adafactor's factored second "
         "moments fit 1B+ configs on one chip)",
     )
@@ -274,6 +274,12 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
     host sampling + H2D, i.e. what the train CLI actually sustains. The
     delta between --prefetch 0 and --prefetch 2 is the input-pipeline
     overlap win (VERDICT r2 #8's queued on-chip measurement)."""
+    noop = {"--ragged": args.ragged, "--kv-dtype": args.kv_dtype,
+            "--decode-unroll": args.decode_unroll}
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(f"{', '.join(bad)} have no effect on the trainer path")
+
     import dataclasses as dc
 
     import jax
@@ -369,6 +375,16 @@ def run_bench(args: argparse.Namespace) -> dict:
         return run_decode_bench(args)
     if args.mode == "trainer":
         return run_trainer_bench(args)
+
+    # Decode-only knobs are REJECTED on the train path (mirror of the
+    # decode-mode noop guard): a silently-ignored flag would emit a record
+    # indistinguishable from the baseline while the operator believes they
+    # measured the override config.
+    noop = {"--ragged": args.ragged, "--kv-dtype": args.kv_dtype,
+            "--decode-unroll": args.decode_unroll}
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(f"{', '.join(bad)} have no effect on the train path")
 
     _stamp("importing jax")
     import jax

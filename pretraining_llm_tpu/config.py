@@ -387,10 +387,13 @@ class TrainConfig:
     eval_iters: int = 250
     lr: float = 3e-4
     lr_schedule: str = "warmup_cosine"  # reference: 10% warmup then constant
-    # "adamw" (reference behavior) or "adafactor" (factored second moments,
+    # "adamw" (reference behavior), "adafactor" (factored second moments,
     # ~0.3 bytes/param optimizer state vs Adam's 8 — fits 1B+ models on one
-    # chip; see training/optimizer.py).
+    # chip), or "muon" (momentum + Newton-Schulz orthogonalization for
+    # hidden weight matrices, AdamW for embeddings/head/vectors — batched
+    # matmul iterations, MXU-native; see training/optimizer.py).
     optimizer: str = "adamw"
+    muon_momentum: float = 0.95  # muon only: nesterov momentum coefficient
     warmup_frac: float = 0.1
     min_lr_frac: float = 0.1  # cosine floor as a fraction of lr
     weight_decay: float = 0.1
@@ -422,9 +425,10 @@ class TrainConfig:
     def __post_init__(self) -> None:
         if self.lr_schedule not in _LR_SCHEDULES:
             raise ValueError(f"lr_schedule must be one of {_LR_SCHEDULES}")
-        if self.optimizer not in ("adamw", "adafactor"):
+        if self.optimizer not in ("adamw", "adafactor", "muon"):
             raise ValueError(
-                f"optimizer must be 'adamw' or 'adafactor', got {self.optimizer!r}"
+                "optimizer must be 'adamw', 'adafactor', or 'muon', "
+                f"got {self.optimizer!r}"
             )
         if self.batch_size % self.microbatches != 0:
             raise ValueError(

@@ -12,6 +12,8 @@ Decoupled weight decay (AdamW), applied only to weight matrices/embeddings
 
 from __future__ import annotations
 
+import math
+
 from typing import Any, Dict, Tuple
 
 import jax
@@ -41,12 +43,16 @@ _NO_DECAY_LEAVES = frozenset(
 )
 
 
+def _leaf_name(path) -> str:
+    """Last path component as a string (DictKey or index)."""
+    return str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
+
+
 def decay_mask(params: Any) -> Any:
     """True for leaves that receive weight decay, keyed on the leaf name."""
 
     def rule(path, leaf):
-        name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
-        return name in _DECAY_LEAVES
+        return _leaf_name(path) in _DECAY_LEAVES
 
     return jax.tree_util.tree_map_with_path(rule, params)
 
@@ -230,10 +236,160 @@ def adafactor_update(
     )
 
 
+# ---------------------------------------------------------------------------
+# Muon (momentum + Newton-Schulz orthogonalization; Jordan et al. 2024,
+# "Muon is Scalable" scaling rule)
+# ---------------------------------------------------------------------------
+#
+# Beyond-reference optimizer choice (the reference has torch AdamW only,
+# train_transformer.py:126). Hidden weight MATRICES take momentum-SGD whose
+# update is orthogonalized by 5 Newton-Schulz iterations — pure batched
+# matmuls, exactly what the MXU is for (the NS cost at gpt2-124m is ~0.1%
+# of step FLOPs). Everything else (embeddings, lm head, biases, norm
+# scales, 1-D leaves) takes the in-repo AdamW path, per the canonical Muon
+# recipe. The update is rescaled by 0.2*sqrt(max(rows, cols)) to match
+# AdamW's update RMS ("Muon is Scalable"), so lr / weight-decay knobs are
+# SHARED with AdamW configs — one schedule, comparable runs.
+#
+# Matrix view of head-structured leaves: a blocks leaf (L, ...) is a batch
+# of L per-layer matrices. wqkv (L, D, 3, H, Dh) maps D -> 3*H*Dh, so rows
+# = axis 1, cols = the rest; wo (L, H, Dh, D) maps H*Dh -> D, so cols =
+# last axis, rows = the middle. Orthogonalization runs on the 2-D view and
+# the update is reshaped back.
+
+_MUON_LEAVES = frozenset({"wqkv", "wq", "wkv", "wo", "w1", "w2", "router"})
+
+# Quintic Newton-Schulz coefficients (Jordan 2024): converge singular
+# values of the normalized momentum into ~[0.7, 1.2] in 5 iterations —
+# loose orthogonality is all Muon needs.
+_NS_COEFFS = (3.4445, -4.7750, 2.0315)
+_NS_STEPS = 5
+_MUON_RMS_MATCH = 0.2  # update-RMS match factor vs AdamW
+
+
+def _muon_leaf(path, leaf) -> bool:
+    return _leaf_name(path) in _MUON_LEAVES and leaf.ndim >= 2
+
+
+def _matrix_view(path, leaf_shape) -> Tuple[int, int, int]:
+    """(batch, rows, cols) of the leaf's 2-D matrix view.
+
+    Leading BATCH axes are the stacked-layer axis (blocks leaves) plus the
+    expert axis for MoE leaves (path contains "experts": w1 (L, E, D, F) /
+    (L, E, D, 2, F), w2 (L, E, F, D) — each EXPERT's matrix is
+    orthogonalized independently, never across experts). The matrix is the
+    linear map the leaf applies: wo contracts everything before its last
+    axis (H*Dh -> D); all other names map their first post-batch axis to
+    the rest (wqkv D -> 3*H*Dh, w1 D -> F or packed 2F, w2 F -> D,
+    router D -> E)."""
+    shape = tuple(leaf_shape)
+    name = _leaf_name(path)
+    n_batch = 1 + any(
+        (str(p.key) if hasattr(p, "key") else str(p)) == "experts" for p in path
+    )
+    n_batch = min(n_batch, len(shape) - 2)  # bare (r, c) test leaves: batch 1
+    b = math.prod(shape[:n_batch])
+    if name == "wo":
+        return b, math.prod(shape[n_batch:-1]), shape[-1]
+    return b, shape[n_batch], math.prod(shape[n_batch + 1:])
+
+
+def newton_schulz_orthogonalize(m: jax.Array, steps: int = _NS_STEPS) -> jax.Array:
+    """Batched (B, r, c) quintic Newton-Schulz iteration toward the nearest
+    semi-orthogonal matrix (zeroth power of the SVD). Iterates in the
+    smaller dimension; fp32 throughout (cost is negligible vs the step)."""
+    a, b, c = _NS_COEFFS
+    transpose = m.shape[-2] > m.shape[-1]
+    x = jnp.swapaxes(m, -1, -2) if transpose else m
+    x = x / (
+        jnp.linalg.norm(x, axis=(-2, -1), keepdims=True) + 1e-7
+    )
+    for _ in range(steps):
+        xxt = jnp.einsum("brc,bsc->brs", x, x)
+        y = b * xxt + c * jnp.einsum("brs,bst->brt", xxt, xxt)
+        x = a * x + jnp.einsum("brs,bsc->brc", y, x)
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+def muon_init(params: Any) -> OptState:
+    """Per-leaf dict state (the adafactor pattern): momentum only for Muon
+    matrices, Adam mu+nu for everything else."""
+
+    def init_leaf(path, p):
+        if _muon_leaf(path, p):
+            return {"m": jnp.zeros(p.shape, jnp.float32)}
+        return {
+            "mu": jnp.zeros(p.shape, jnp.float32),
+            "nu": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    return {
+        "s": jax.tree_util.tree_map_with_path(init_leaf, params),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def muon_update(
+    grads: Any,
+    state: OptState,
+    params: Any,
+    lr: jax.Array,
+    cfg: TrainConfig,
+) -> Tuple[Any, OptState]:
+    """One Muon step (nesterov momentum -> NS orthogonalization -> RMS-match
+    scaling) for hidden matrices; AdamW math for the rest. All fp32."""
+    count = state["count"] + 1
+    mu_m = cfg.muon_momentum
+    b1, b2, eps, wd = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps, cfg.weight_decay
+    c32 = count.astype(jnp.float32)
+    bc1 = 1.0 - b1**c32
+    bc2 = 1.0 - b2**c32
+    mask = decay_mask(params)
+
+    def leaf_update(path, g, s, p, decay):
+        g32 = g.astype(jnp.float32)
+        if "m" in s:
+            m_new = mu_m * s["m"] + g32
+            u_in = g32 + mu_m * m_new  # nesterov
+            bsz, rows, cols = _matrix_view(path, p.shape)
+            u2d = newton_schulz_orthogonalize(u_in.reshape(bsz, rows, cols))
+            scale = _MUON_RMS_MATCH * float(max(rows, cols)) ** 0.5
+            u = (u2d * scale).reshape(p.shape)
+            s_new = {"m": m_new}
+        else:
+            mu_new = b1 * s["mu"] + (1 - b1) * g32
+            nu_new = b2 * s["nu"] + (1 - b2) * jnp.square(g32)
+            u = (mu_new / bc1) / (jnp.sqrt(nu_new / bc2) + eps)
+            s_new = {"mu": mu_new, "nu": nu_new}
+        if decay and wd > 0:
+            u = u + wd * p.astype(jnp.float32)
+        p_new = p.astype(jnp.float32) - lr * u
+        return p_new.astype(p.dtype), s_new
+
+    flat_g = jax.tree.leaves(grads)
+    treedef = jax.tree.structure(params)
+    flat_s = jax.tree.leaves(
+        state["s"], is_leaf=lambda x: isinstance(x, dict) and ("m" in x or "mu" in x)
+    )
+    flat_p_paths, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_mask = jax.tree.leaves(mask)
+    new_p, new_s = [], []
+    for (path, p), g, s, d in zip(flat_p_paths, flat_g, flat_s, flat_mask):
+        pn, sn = leaf_update(path, g, s, p, d)
+        new_p.append(pn)
+        new_s.append(sn)
+    return (
+        jax.tree.unflatten(treedef, new_p),
+        {"s": jax.tree.unflatten(treedef, new_s), "count": count},
+    )
+
+
 def optimizer_init(params: Any, cfg: TrainConfig) -> OptState:
-    """Dispatch by cfg.optimizer ('adamw' | 'adafactor')."""
+    """Dispatch by cfg.optimizer ('adamw' | 'adafactor' | 'muon')."""
     if cfg.optimizer == "adafactor":
         return adafactor_init(params)
+    if cfg.optimizer == "muon":
+        return muon_init(params)
     return adamw_init(params)
 
 
@@ -242,6 +398,8 @@ def optimizer_update(
 ) -> Tuple[Any, OptState]:
     if cfg.optimizer == "adafactor":
         return adafactor_update(grads, state, params, lr, cfg)
+    if cfg.optimizer == "muon":
+        return muon_update(grads, state, params, lr, cfg)
     return adamw_update(grads, state, params, lr, cfg)
 
 

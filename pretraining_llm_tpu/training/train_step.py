@@ -61,6 +61,19 @@ def state_pspec_tree(
             "v": jax.tree.map(lambda _: P(), state["opt"]["v"]),
             "count": P(),
         }
+    elif "s" in state["opt"]:
+        # Muon: every per-leaf state array (muon momentum "m", or adam
+        # "mu"/"nu" for the non-matrix leaves) mirrors its param's shape —
+        # shard each exactly like the param (FSDP shards momentum for
+        # free, same as adamw's moments). tree.map flattens the per-leaf
+        # state dict UP TO the param pspec tree, so each dict maps to
+        # {key: param_pspec}.
+        opt_pspecs = {
+            "s": jax.tree.map(
+                lambda ps, sd: {k: ps for k in sd}, pspecs, state["opt"]["s"]
+            ),
+            "count": P(),
+        }
     else:
         opt_pspecs = {
             "mu": param_pspec_tree(state["opt"]["mu"], pipeline, **kw),
