@@ -754,7 +754,12 @@ def wrapper_main(args: argparse.Namespace) -> int:
         # >=50% bar: zero block recompute AND zero CE-logits recompute;
         # none@8+chunked backs it up in case the dense head has an
         # unexpected pathology at this shape.
+        # save_attn@16+dense: the measured-best remat/batch with the CE
+        # logits-recompute (~10% of analytic step FLOPs) removed — the
+        # cheapest projected step past 41.6%; saved logits at b16 are
+        # ~1.65 GB, well within budget on top of save_attn's footprint.
         candidates = [
+            ("save_attn", "", 0, "dense", True),
             ("save_attn", "", 0, "", True),
             ("none", "", 8, "dense", True),
             ("none", "", 8, "", True),
