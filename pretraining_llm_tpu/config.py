@@ -101,6 +101,15 @@ class ModelConfig:
     decode_unroll_layers: bool = False
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
+    # Packed-document attention masking: >= 0 names the document-separator
+    # token id (the EOT the preprocessor appends per document); attention
+    # then never crosses a document boundary. Segment ids are derived
+    # IN-MODEL from the token stream (exclusive running count of
+    # separators) — no data-pipeline change. -1 = off (the reference, and
+    # GPT-2/3-style packing, attend across document boundaries).
+    # Training/eval only; naive + flash attention paths (ring/ulysses/
+    # pipeline compositions are rejected at validation).
+    doc_mask_token: int = -1
     # Mixture-of-experts MLP (0 = dense). Experts shard over the 'expert' mesh
     # axis; routing is dense einsum dispatch with a per-expert capacity bound.
     n_experts: int = 0
@@ -212,6 +221,24 @@ class ModelConfig:
                 "pipeline parallelism does not compose with sequence/context "
                 "parallelism (ring/ulysses attention or sequence_parallel)"
             )
+        if self.doc_mask_token >= 0:
+            if self.attention_impl in ("ring", "ulysses"):
+                raise ValueError(
+                    "doc_mask_token (packed-document masking) is not "
+                    "supported by ring/ulysses attention — segment ids are "
+                    "not threaded through their collectives"
+                )
+            if self.pipeline_stages > 1:
+                raise ValueError(
+                    "doc_mask_token does not compose with pipeline "
+                    "parallelism (segments are not threaded through the "
+                    "pipelined block path)"
+                )
+            if self.doc_mask_token >= self.vocab_size:
+                raise ValueError(
+                    f"doc_mask_token={self.doc_mask_token} is outside the "
+                    f"vocabulary (vocab_size={self.vocab_size})"
+                )
 
     @property
     def head_dim(self) -> int:

@@ -244,6 +244,15 @@ def generate(
     for one chip — pass params already placed with
     `shard_params_for_inference`; activations follow the param shardings.
     """
+    if cfg.doc_mask_token >= 0:
+        # Packed-document masking is a TRAINING-time attention structure; a
+        # decode session is a single document, so the mask is vacuous — and
+        # forward() rejects the combination with a KV cache. A checkpoint
+        # trained with packing must still decode (the e2e contract), so
+        # sanitize here like decode_bench_workload does for ring/ulysses.
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, doc_mask_token=-1)
     prompt = jnp.atleast_2d(jnp.asarray(prompt_tokens, jnp.int32))
     prompt_len = int(prompt.shape[1])
     if prompt_len + max_new_tokens > cfg.context_length:

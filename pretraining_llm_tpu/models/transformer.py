@@ -150,6 +150,7 @@ def _attention_block(
     cache_index: Optional[jax.Array],
     zigzag: bool = False,
     pad_offsets: Optional[jax.Array] = None,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[Params]]:
     """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv).
 
@@ -342,6 +343,7 @@ def _attention_block(
             block_q=cfg.flash_block_q,
             block_kv=cfg.flash_block_kv,
             ring_layout="zigzag" if zigzag else "contiguous",
+            segments=segments,
         )
 
     # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,
@@ -403,9 +405,11 @@ def _block(
     cache_index: Optional[jax.Array],
     zigzag: bool = False,
     pad_offsets: Optional[jax.Array] = None,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[Params], jax.Array]:
     x, new_kv = _attention_block(
-        blk, x, cfg, rope, positions, kv, cache_index, zigzag, pad_offsets
+        blk, x, cfg, rope, positions, kv, cache_index, zigzag, pad_offsets,
+        segments=segments,
     )
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
@@ -492,6 +496,23 @@ def forward(
         start = cache_index if cache_index is not None else 0
         positions = start + jnp.arange(t)
 
+    # Packed-document masking: derive per-token document ids from the
+    # separator token IN-MODEL (no data-pipeline change — the uint16 token
+    # stream already contains the per-document EOT appended at preprocess
+    # time). Token i belongs to document #(separators strictly before i),
+    # so the separator itself is the LAST token of its document; attention
+    # never crosses a boundary. Training/eval only — generation of a
+    # packed stream is meaningless, and validation forbids the combination.
+    segments = None
+    if cfg.doc_mask_token >= 0:
+        if kv_cache is not None:
+            raise ValueError(
+                "doc_mask_token is a training/eval feature; cached decode "
+                "must run with doc masking disabled"
+            )
+        is_sep = (tokens == cfg.doc_mask_token).astype(jnp.int32)
+        segments = jnp.cumsum(is_sep, axis=1) - is_sep  # exclusive cumsum
+
     # Replicate the (vocab x fsdp)-sharded table explicitly before the
     # lookup: the gather's output sharding then propagates from the
     # batch-sharded token indices. Left implicit, XLA propagates the TABLE's
@@ -517,7 +538,10 @@ def forward(
         x, aux_sum = carry
         if kv_cache is None:
             blk = layer_inputs
-            x, _, aux = _block(blk, x, cfg, rope, positions, None, None, zigzag)
+            x, _, aux = _block(
+                blk, x, cfg, rope, positions, None, None, zigzag,
+                segments=segments,
+            )
             return (x, aux_sum + aux), (x if return_hidden else None)
         blk, cache_layer = layer_inputs
         x, new_kv, aux = _block(

@@ -32,6 +32,7 @@ def naive_attention(
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
     causal: bool = True,
+    segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference einsum attention. q: (B, Tq, H, Dh); k, v: (B, Tk, G, Dh).
 
@@ -42,6 +43,8 @@ def naive_attention(
     ``q_positions``/``kv_positions`` (shape (Tq,), (Tk,)) define causality for
     KV-cached decode where the query block sits at an offset; they default to
     aligned ranges. ``kv_mask`` (B, Tk) masks out unwritten cache slots.
+    ``segments`` (B, T) int32 document ids (self-attention, Tq == Tk):
+    attention never crosses a document boundary (packed-sequence training).
     """
     b, tq, h, dh = q.shape
     tk, g = k.shape[1], k.shape[2]
@@ -59,6 +62,11 @@ def naive_attention(
         scores = jnp.where(causal_mask[None, None, None, :, :], scores, -jnp.inf)
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, None, :], scores, -jnp.inf)
+    if segments is not None:
+        if tq != tk:
+            raise ValueError("segments requires self-attention (Tq == Tk)")
+        seg_ok = segments[:, :, None] == segments[:, None, :]  # (B, Tq, Tk)
+        scores = jnp.where(seg_ok[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     if kv_mask is not None:
         # A query slot whose EVERY key is masked (a dead left-pad slot in
@@ -92,6 +100,7 @@ def multihead_attention(
     block_q: int = 0,
     block_kv: int = 0,
     ring_layout: str = "contiguous",
+    segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatch over attention implementations.
 
@@ -103,6 +112,14 @@ def multihead_attention(
     sequence dim (models.transformer.loss_fn does this).
     """
     if impl in ("ring", "ulysses"):
+        if segments is not None:
+            # The rotating-KV / all-to-all layouts would need segment ids
+            # threaded through their collectives; config validation forbids
+            # doc_mask with these impls — this is the backstop.
+            raise ValueError(
+                "segments (document masking) is not supported by the "
+                "ring/ulysses sequence-parallel attention paths"
+            )
         from pretraining_llm_tpu.parallel.sharding import current_mesh
 
         mesh = current_mesh()
@@ -131,9 +148,18 @@ def multihead_attention(
             q_positions=q_positions,
             kv_positions=kv_positions,
             kv_mask=kv_mask,
+            segments=segments,
         )
     if impl == "flash":
         if q_positions is not None or kv_positions is not None or kv_mask is not None:
+            if segments is not None:
+                # Loud, like the ring/ulysses backstop: silently dropping
+                # the mask here would reintroduce the cross-document leak
+                # the feature exists to prevent.
+                raise ValueError(
+                    "segments (document masking) is not supported on the "
+                    "cached-decode attention path"
+                )
             # Cached decode shapes are small; the flash kernel targets training.
             return naive_attention(
                 q,
@@ -146,5 +172,8 @@ def multihead_attention(
             )
         from pretraining_llm_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
+            segments=segments,
+        )
     raise ValueError(f"unknown attention impl {impl!r}")

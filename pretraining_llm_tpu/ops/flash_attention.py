@@ -45,6 +45,7 @@ def blockwise_attention(
     block_q: int = 0,
     block_kv: int = 0,
     q_offset: Any = 0,
+    segments: Any = None,
 ) -> jax.Array:
     """Online-softmax attention. q: (B, Tq, H, Dh), k/v: (B, Tk, G, Dh)
     with G | H -> (B, Tq, H, Dh). Tq and Tk may differ.
@@ -58,6 +59,10 @@ def blockwise_attention(
     causal mask — the rectangular form chunked prefill needs (each chunk
     attends the already-written cache prefix; keys above the frontier are
     causally excluded, so no explicit length mask is required).
+
+    ``segments`` (B, T) int32 document ids (self-attention only, Tq == Tk):
+    queries attend only keys of their own document — packed-sequence
+    training without cross-document attention.
     """
     b, tq_len, h, dh = q.shape
     tk_len, g = k.shape[1], k.shape[2]
@@ -70,14 +75,21 @@ def blockwise_attention(
     qb = q.reshape(b, nq, bq, g, r, dh)
     kb = k.reshape(b, nk, bk, g, dh)
     vb = v.reshape(b, nk, bk, g, dh)
+    has_seg = segments is not None
+    if has_seg:
+        if tq_len != tk_len:
+            raise ValueError("segments requires self-attention (Tq == Tk)")
+        seg32 = segments.astype(jnp.int32)
+        sqb = seg32.reshape(b, nq, bq)
+        skb = seg32.reshape(b, nk, bk)
 
     q_ids = jnp.arange(bq)
     k_ids = jnp.arange(bk)
 
     @jax.checkpoint
     def kv_step(carry, inputs):
-        o, m, l, qi, q_block = carry
-        kj, k_block, v_block = inputs
+        o, m, l, qi, q_block, sq_block = carry
+        kj, k_block, v_block, sk_block = inputs
         s = (
             jnp.einsum(
                 "bqgrd,bkgd->bgrqk", q_block, k_block,
@@ -90,6 +102,11 @@ def blockwise_attention(
             k_pos = kj * bk + k_ids  # (bk,)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        if has_seg:
+            # True -inf: the existing isfinite() guards zero p/alpha for
+            # fully cross-document blocks.
+            seg_ok = sq_block[:, :, None] == sk_block[:, None, :]  # (B,bq,bk)
+            s = jnp.where(seg_ok[:, None, None], s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, G, R, bq)
         # exp(-inf - -inf) guard: rows of a fully-masked block keep m = -inf
         p = jnp.exp(s - m_new[..., None])
@@ -102,18 +119,23 @@ def blockwise_attention(
             preferred_element_type=jnp.float32,
         )
         o = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
-        return (o, m_new, l, qi, q_block), None
+        return (o, m_new, l, qi, q_block, sq_block), None
 
-    def q_block_fn(qi, q_block):
+    def q_block_fn(qi, q_block, sq_block):
         o0 = jnp.zeros((b, bq, g, r, dh), jnp.float32)
         m0 = jnp.full((b, g, r, bq), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((b, g, r, bq), jnp.float32)
-        (o, m, l, _, _), _ = jax.lax.scan(
-            kv_step, (o0, m0, l0, qi, q_block), (jnp.arange(nk), kb.swapaxes(0, 1), vb.swapaxes(0, 1))
+        sk_scan = skb.swapaxes(0, 1) if has_seg else jnp.zeros((nk, b, 1), jnp.int32)
+        (o, m, l, _, _, _), _ = jax.lax.scan(
+            kv_step, (o0, m0, l0, qi, q_block, sq_block),
+            (jnp.arange(nk), kb.swapaxes(0, 1), vb.swapaxes(0, 1), sk_scan)
         )
         return o / l.transpose(0, 3, 1, 2)[..., None]
 
-    out = jax.lax.map(lambda args: q_block_fn(*args), (jnp.arange(nq), qb.swapaxes(0, 1)))
+    sq_map = sqb.swapaxes(0, 1) if has_seg else jnp.zeros((nq, b, 1), jnp.int32)
+    out = jax.lax.map(
+        lambda args: q_block_fn(*args), (jnp.arange(nq), qb.swapaxes(0, 1), sq_map)
+    )
     # out: (nq, B, bq, G, R, Dh) -> (B, Tq, H, Dh)
     return out.transpose(1, 0, 2, 3, 4, 5).reshape(b, tq_len, h, dh).astype(q.dtype)
 
@@ -126,7 +148,8 @@ def _pallas_available() -> bool:
         return False
 
 
-def shard_mapped_kernel(kernel, q, k, v, mesh, *, batch_axes=("data", "fsdp")):
+def shard_mapped_kernel(kernel, q, k, v, mesh, *, batch_axes=("data", "fsdp"),
+                        segments=None):
     """Run an attention kernel per-shard under a batch/head-sharded mesh.
 
     GSPMD cannot partition a pallas_call — traced directly on sharded
@@ -153,6 +176,13 @@ def shard_mapped_kernel(kernel, q, k, v, mesh, *, batch_axes=("data", "fsdp")):
         return None
     head_ax = "tensor" if tp > 1 else None
     spec = P(batch_axes, None, head_ax, None)
+    if segments is not None:
+        seg_spec = P(batch_axes, None)
+        return jax.shard_map(
+            lambda q_, k_, v_, s_: kernel(q_, k_, v_, segments=s_),
+            mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v, segments)
     return jax.shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
@@ -167,12 +197,17 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 0,
     block_kv: int = 0,
+    segments: Any = None,
 ) -> jax.Array:
     """Memory-efficient attention; Pallas kernel on TPU, blockwise JAX elsewhere.
 
     q: (B, T, H, D); k, v: (B, T, G, D) with G | H. The Pallas kernel handles
     GQA natively (query groups index shared KV blocks); the blockwise
     fallback is GQA-native too (grouped einsums, K/V never expanded).
+
+    ``segments`` (B, T) int32 document ids: packed-sequence training —
+    attention (and its VJP) never crosses a document boundary. Threaded
+    into whichever tier serves the call.
     """
     if q.shape[2] % k.shape[2] != 0:
         # Same fail-fast the Pallas path gives; without it the CPU fallback
@@ -189,7 +224,7 @@ def flash_attention(
             )
             mesh = current_mesh()
             if mesh is None or all(s == 1 for s in mesh.shape.values()):
-                return kernel(q, k, v)
+                return kernel(q, k, v, segments=segments)
             # Manual-region classification (ADVICE r2): the direct kernel
             # call is only correct when EVERY nontrivial mesh axis is manual
             # (ulysses' all-to-all body — operands are per-device local
@@ -207,9 +242,9 @@ def flash_attention(
             }
             nontrivial = {name for name, size in mesh.shape.items() if size > 1}
             if nontrivial <= manual_axes:
-                return kernel(q, k, v)  # fully manual region
+                return kernel(q, k, v, segments=segments)  # fully manual region
             if not manual_axes:
-                out = shard_mapped_kernel(kernel, q, k, v, mesh)
+                out = shard_mapped_kernel(kernel, q, k, v, mesh, segments=segments)
                 if out is not None:
                     return out
             # Partial-manual region, or unexpressible per-shard layout
@@ -235,4 +270,7 @@ def flash_attention(
         except ImportError:
             pass  # kernel module not built yet; blockwise path is correct
     # blockwise_attention is GQA-native (grouped einsums) — no K/V expansion.
-    return blockwise_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+    return blockwise_attention(
+        q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
+        segments=segments,
+    )

@@ -33,6 +33,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -69,7 +70,15 @@ def _block_sizes(t: int, block_q: int, block_kv: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causal, scale, bq, bk, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg):
+    # `seg` (static) threads document-segment refs: sq (bq, 1) / sk (1, bk)
+    # int32 blocks riding the proven trailing-singleton stats layouts; a
+    # query may only attend keys of its own document. seg=False traces the
+    # exact op sequence the measured kernels compiled — the proven class.
+    if seg:
+        sq_ref, sk_ref, o_ref, lse_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, lse_ref, acc, m_scr, l_scr = rest
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # kv block
 
@@ -94,10 +103,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causa
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if seg:
+            seg_ok = sq_ref[0] == sk_ref[0]  # (bq, bk)
+            s = jnp.where(seg_ok, s, NEG_INF)
         m_prev = m_scr[:]  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # (bq, bk) f32
+        if seg:
+            # NEG_INF is finite: in a FULLY cross-document block m_new ==
+            # NEG_INF and exp(s - m_new) == 1 for every masked entry (the
+            # causal path never runs such a block, segments do). Zero p by
+            # the mask itself, not by exp underflow.
+            p = jnp.where(seg_ok, p, 0.0)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = m_new
         pv = jax.lax.dot_general(
@@ -113,9 +131,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causa
         lse_ref[0] = m_scr[:] + jnp.log(safe_l)  # (bq, 1)
 
 
+def _seg_views(segments: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(b, t) int32 document ids -> q-side (b, t, 1) and k-side (b, 1, t)
+    views, each blockable with the proven trailing-singleton / single-
+    sublane layouts (no in-kernel transpose)."""
+    s32 = segments.astype(jnp.int32)
+    return s32[:, :, None], s32[:, None, :]
+
+
 def _fwd(
     q: jax.Array, k: jax.Array, v: jax.Array, h: int, g: int, *,
     causal: bool, block_q: int, block_kv: int, interpret: bool,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     bh, t, d = q.shape
     b = bh // h
@@ -124,19 +151,29 @@ def _fwd(
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d**0.5)
 
+    seg = segments is not None
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk, seg=seg
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
+        # GQA: the group's query heads share one KV head — index division,
+        # never a materialized repeat.
+        pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if seg:
+        sq3, sk3 = _seg_views(segments)
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb, i, 0)),
+            pl.BlockSpec((1, 1, bk), lambda bb, hh, i, j: (bb, 0, j)),
+        ]
+        inputs += [sq3, sk3]
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
-            # GQA: the group's query heads share one KV head — index division,
-            # never a materialized repeat.
-            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
             # Stats ride in a trailing singleton lane dim: block (bq, 1) on
@@ -154,7 +191,7 @@ def _fwd(
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse
 
 
@@ -164,8 +201,12 @@ def _fwd(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, causal, scale, bq, bk, nk
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, causal, scale, bq, bk, nk, seg
 ):
+    if seg:
+        sq_ref, sk_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -194,6 +235,12 @@ def _bwd_dq_kernel(
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
+        if seg:
+            # Explicit zero (not exp underflow): lse for a real row is
+            # finite, but masked-s NEG_INF is finite too — exp stays ~0
+            # there; the guard is for degenerate all-masked rows where
+            # lse == NEG_INF would give exp(0) == 1 (see _fwd_kernel).
+            p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -208,9 +255,13 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-    *, causal, scale, bq, bk, nq, n_inner
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal, scale, bq, bk, nq, n_inner, seg
 ):
+    if seg:
+        sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     j = pl.program_id(2)  # kv block (outer)
     ri = pl.program_id(3)  # inner: (q head within group) * nq + q block
     i = ri % nq
@@ -239,6 +290,8 @@ def _bwd_dkv_kernel(
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
+        if seg:
+            p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)  # see _bwd_dq_kernel
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -259,9 +312,13 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_fused_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal, scale, n_rep
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal, scale, n_rep, seg
 ):
+    if seg:
+        sq_ref, sk_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dq_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     """Single-block backward (t <= one block): dQ, dK, dV in ONE pass.
 
     The two-kernel FA2 split exists because dQ accumulates over kv blocks
@@ -289,6 +346,8 @@ def _bwd_fused_kernel(
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lse)
+    if seg:
+        p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)  # see _bwd_dq_kernel
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -320,7 +379,8 @@ def _bwd_fused_kernel(
 
 
 def _bwd(
-    h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad
+    h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     q, k, v, o, lse2 = residuals
     lse = lse2[..., None]
@@ -332,22 +392,34 @@ def _bwd(
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d**0.5)
 
+    seg = segments is not None
+    seg_inputs: list = []
+    if seg:
+        sq3, sk3 = _seg_views(segments)
+        seg_inputs = [sq3, sk3]
+
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (bh, t, 1)
 
     if nq == 1 and nk == 1:
-        dq, dk, dv = pl.pallas_call(
-            functools.partial(
-                _bwd_fused_kernel, causal=causal, scale=scale, n_rep=n_rep
-            ),
-            grid=(b, g, n_rep),
-            in_specs=[
+        in_specs = [
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # q
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),  # k
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),  # v
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # do
                 pl.BlockSpec((1, t, 1), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # lse
                 pl.BlockSpec((1, t, 1), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),  # delta
-            ],
+        ]
+        if seg:
+            in_specs += [
+                pl.BlockSpec((1, t, 1), lambda bb, hh, r: (bb, 0, 0)),  # seg q-side
+                pl.BlockSpec((1, 1, t), lambda bb, hh, r: (bb, 0, 0)),  # seg k-side
+            ]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel, causal=causal, scale=scale, n_rep=n_rep, seg=seg
+            ),
+            grid=(b, g, n_rep),
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * h + hh * n_rep + r, 0, 0)),
                 pl.BlockSpec((1, t, d), lambda bb, hh, r: (bb * g + hh, 0, 0)),
@@ -363,25 +435,33 @@ def _bwd(
                 pltpu.VMEM((t, d), jnp.float32),
             ],
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse, delta, *seg_inputs)
         return dq, dk, dv
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk),
-        grid=(b, h, nq, nk),
-        in_specs=[
+    dq_in_specs = [
             pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda bb, hh, i, j: (bb * g + hh // n_rep, j, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # do
             pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # lse
             pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb * h + hh, i, 0)),  # delta
-        ],
+    ]
+    if seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, i, j: (bb, i, 0)),  # seg q-side
+            pl.BlockSpec((1, 1, bk), lambda bb, hh, i, j: (bb, 0, j)),  # seg k-side
+        ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk, seg=seg
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_inputs)
 
     # dK/dV: grid over KV heads; the inner axis walks the group's n_rep query
     # heads x nq q-blocks, accumulating into one (bk, d) scratch per kv block.
@@ -390,19 +470,26 @@ def _bwd(
     def q_row(bb, hh, j, ri):
         return bb * h + hh * n_rep + ri // nq
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq, n_inner=n_inner
-        ),
-        grid=(b, g, nk, n_inner),
-        in_specs=[
+    dkv_in_specs = [
             pl.BlockSpec((1, bq, d), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # do
             pl.BlockSpec((1, bq, 1), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # lse
             pl.BlockSpec((1, bq, 1), lambda bb, hh, j, ri: (q_row(bb, hh, j, ri), ri % nq, 0)),  # delta
-        ],
+    ]
+    if seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bb, hh, j, ri: (bb, ri % nq, 0)),  # seg q-side
+            pl.BlockSpec((1, 1, bk), lambda bb, hh, j, ri: (bb, 0, j)),  # seg k-side
+        ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq,
+            n_inner=n_inner, seg=seg
+        ),
+        grid=(b, g, nk, n_inner),
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bb, hh, j, ri: (bb * g + hh, j, 0)),
@@ -416,7 +503,7 @@ def _bwd(
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_inputs)
     return dq, dk, dv
 
 
@@ -452,6 +539,36 @@ def _flash_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Segment-masked variant: identical kernels with the document-mask refs
+# threaded (seg=True). A separate custom_vjp keeps the measured non-segment
+# path's trace byte-identical. `segments` is an int32 primal whose
+# cotangent space is float0 (non-differentiable by construction).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_seg(q, k, v, segments, h, g, causal, block_q, block_kv, interpret):
+    o, _ = _fwd(q, k, v, h, g, causal=causal, block_q=block_q,
+                block_kv=block_kv, interpret=interpret, segments=segments)
+    return o
+
+
+def _flash_seg_fwd(q, k, v, segments, h, g, causal, block_q, block_kv, interpret):
+    o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q,
+                  block_kv=block_kv, interpret=interpret, segments=segments)
+    o_res = checkpoint_name(o, "attn_o_res")
+    lse2 = checkpoint_name(lse[..., 0], "attn_lse")
+    return o, (q, k, v, o_res, lse2, segments)
+
+
+def _flash_seg_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):
+    *res, segments = residuals
+    dq, dk, dv = _bwd(h, g, causal, block_q, block_kv, interpret, tuple(res),
+                      grad, segments=segments)
+    dseg = np.zeros(segments.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
 def pallas_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -461,10 +578,16 @@ def pallas_flash_attention(
     block_q: int = 0,
     block_kv: int = 0,
     interpret: Optional[bool] = None,
+    segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention. q: (B, T, H, Dh); k, v: (B, T, G, Dh) with G | H
     (grouped-query attention — G < H never materializes repeated K/V).
     Returns (B, T, H, Dh).
+
+    ``segments`` (B, T) int32 document ids restricts attention to keys of
+    the query's own document (packed-sequence training; composed with the
+    causal mask inside the kernel — cross-document pairs never contribute
+    to the online softmax or its VJP).
 
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere
     (slow — tests only).
@@ -476,5 +599,13 @@ def pallas_flash_attention(
     if h % g != 0:
         raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
     qf, kf, vf = _heads_first(q), _heads_first(k), _heads_first(v)
-    of = _flash(qf, kf, vf, h, g, causal, block_q, block_kv, interpret)
+    if segments is not None:
+        if segments.shape != (b, t):
+            raise ValueError(
+                f"segments must be (batch, seq) = ({b}, {t}), got {segments.shape}"
+            )
+        of = _flash_seg(qf, kf, vf, segments.astype(jnp.int32), h, g, causal,
+                        block_q, block_kv, interpret)
+    else:
+        of = _flash(qf, kf, vf, h, g, causal, block_q, block_kv, interpret)
     return _heads_last(of, b, h)
