@@ -101,6 +101,12 @@ class ModelConfig:
     decode_unroll_layers: bool = False
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
+    # Sliding-window attention (Mistral-style): each query attends only the
+    # last `sliding_window` positions (0 = full causal attention). The
+    # flash kernel SKIPS blocks entirely outside the window (compute drops
+    # from O(T^2) to O(T*W) at long context); cached decode masks old
+    # slots. naive/flash paths; ring/ulysses rejected at validation.
+    sliding_window: int = 0
     # Packed-document attention masking: >= 0 names the document-separator
     # token id (the EOT the preprocessor appends per document); attention
     # then never crosses a document boundary. Segment ids are derived
@@ -220,6 +226,13 @@ class ModelConfig:
             raise ValueError(
                 "pipeline parallelism does not compose with sequence/context "
                 "parallelism (ring/ulysses attention or sequence_parallel)"
+            )
+        if self.sliding_window < 0:
+            raise ValueError("sliding_window must be >= 0 (0 = full causal)")
+        if self.sliding_window > 0 and self.attention_impl in ("ring", "ulysses"):
+            raise ValueError(
+                "sliding_window is not supported by ring/ulysses attention "
+                "(the rotating/all-to-all layouts assume full causal KV)"
             )
         if self.doc_mask_token >= 0:
             if self.attention_impl in ("ring", "ulysses"):

@@ -268,6 +268,7 @@ def _attention_block(
             out = multihead_attention(
                 q, k, v, impl="flash",
                 block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
+                window=cfg.sliding_window,
             )
         elif (
             tq > 1
@@ -286,23 +287,35 @@ def _attention_block(
             from pretraining_llm_tpu.ops.flash_attention import blockwise_attention
 
             kv_view = new_kv
+            k_lo = 0
             if not isinstance(cache_index, jax.core.Tracer):
                 # Concrete offset (host-side chunk loops): slice off the
                 # key blocks that lie entirely beyond the frontier before
                 # dequant/attention — they would contribute only masked
                 # scores (~2x the needed FLOPs on a mid-cache chunk).
                 # Round up to the configured KV tile so the slice never
-                # shrinks the block _pick_block would choose.
+                # shrinks the block _pick_block would choose. With a
+                # sliding window, ALSO slice off the below-window prefix
+                # (tile-aligned down) — otherwise chunked windowed prefill
+                # pays O(T^2) scanning keys that are entirely masked;
+                # k_offset keeps the sliced keys' positions absolute.
                 tile = cfg.flash_block_kv or 512
                 hi = min(tmax, -(-(int(cache_index) + tq) // tile) * tile)
+                if cfg.sliding_window:
+                    k_lo = max(
+                        0,
+                        (int(cache_index) - cfg.sliding_window + 1)
+                        // tile * tile,
+                    )
                 kv_view = {
-                    name: buf[:, :hi] for name, buf in new_kv.items()
+                    name: buf[:, k_lo:hi] for name, buf in new_kv.items()
                 }
             ck, cv = _materialize_cache(kv_view, quantized, cdt)
             out = blockwise_attention(
                 q, ck, cv, causal=True,
                 block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
-                q_offset=cache_index,
+                q_offset=cache_index, k_offset=k_lo,
+                window=cfg.sliding_window,
             )
         else:
             kv_positions = jnp.arange(tmax)
@@ -320,6 +333,7 @@ def _attention_block(
                 q_positions=positions,
                 kv_positions=kv_positions,
                 kv_mask=kv_mask,
+                window=cfg.sliding_window,
             )
     else:
         grouped_ok = cfg.attention_impl in ("naive", "flash")
@@ -344,6 +358,7 @@ def _attention_block(
             block_kv=cfg.flash_block_kv,
             ring_layout="zigzag" if zigzag else "contiguous",
             segments=segments,
+            window=cfg.sliding_window,
         )
 
     # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,

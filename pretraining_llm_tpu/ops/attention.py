@@ -33,6 +33,7 @@ def naive_attention(
     kv_mask: Optional[jax.Array] = None,
     causal: bool = True,
     segments: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Reference einsum attention. q: (B, Tq, H, Dh); k, v: (B, Tk, G, Dh).
 
@@ -53,13 +54,20 @@ def naive_attention(
     scores = jnp.einsum(
         "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
     ) * scale  # (B, G, H/G, Tq, Tk)
-    if causal:
+    if causal or window:
         if q_positions is None:
             q_positions = jnp.arange(tq) + (tk - tq)  # aligned suffix by default
         if kv_positions is None:
             kv_positions = jnp.arange(tk)
+    if causal:
         causal_mask = q_positions[:, None] >= kv_positions[None, :]  # (Tq, Tk)
         scores = jnp.where(causal_mask[None, None, None, :, :], scores, -jnp.inf)
+    if window:
+        # Sliding window: a query sees only the last `window` positions —
+        # the cached-decode form of Mistral-style attention (old cache
+        # slots are masked, not evicted).
+        w_ok = (q_positions[:, None] - kv_positions[None, :]) < window
+        scores = jnp.where(w_ok[None, None, None, :, :], scores, -jnp.inf)
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, None, :], scores, -jnp.inf)
     if segments is not None:
@@ -101,6 +109,7 @@ def multihead_attention(
     block_kv: int = 0,
     ring_layout: str = "contiguous",
     segments: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatch over attention implementations.
 
@@ -112,6 +121,11 @@ def multihead_attention(
     sequence dim (models.transformer.loss_fn does this).
     """
     if impl in ("ring", "ulysses"):
+        if window:
+            raise ValueError(
+                "sliding-window attention is not supported by the "
+                "ring/ulysses sequence-parallel attention paths"
+            )
         if segments is not None:
             # The rotating-KV / all-to-all layouts would need segment ids
             # threaded through their collectives; config validation forbids
@@ -149,6 +163,7 @@ def multihead_attention(
             kv_positions=kv_positions,
             kv_mask=kv_mask,
             segments=segments,
+            window=window,
         )
     if impl == "flash":
         if q_positions is not None or kv_positions is not None or kv_mask is not None:
@@ -166,6 +181,7 @@ def multihead_attention(
                 k,
                 v,
                 causal=causal,
+                window=window,
                 q_positions=q_positions,
                 kv_positions=kv_positions,
                 kv_mask=kv_mask,
@@ -174,6 +190,6 @@ def multihead_attention(
 
         return flash_attention(
             q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
-            segments=segments,
+            segments=segments, window=window,
         )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -45,7 +45,9 @@ def blockwise_attention(
     block_q: int = 0,
     block_kv: int = 0,
     q_offset: Any = 0,
+    k_offset: int = 0,
     segments: Any = None,
+    window: int = 0,
 ) -> jax.Array:
     """Online-softmax attention. q: (B, Tq, H, Dh), k/v: (B, Tk, G, Dh)
     with G | H -> (B, Tq, H, Dh). Tq and Tk may differ.
@@ -63,6 +65,11 @@ def blockwise_attention(
     ``segments`` (B, T) int32 document ids (self-attention only, Tq == Tk):
     queries attend only keys of their own document — packed-sequence
     training without cross-document attention.
+
+    ``window`` > 0: sliding-window attention (each query sees the last
+    `window` positions only). ``k_offset`` places the KEYS at positions
+    [k_offset, k_offset+Tk) — chunked windowed prefill passes a trimmed
+    cache view whose below-window prefix was sliced off.
     """
     b, tq_len, h, dh = q.shape
     tk_len, g = k.shape[1], k.shape[2]
@@ -97,11 +104,15 @@ def blockwise_attention(
             )
             * scale
         )  # (B, G, R, bq, bk) fp32
-        if causal:
+        if causal or window:
             q_pos = q_offset + qi * bq + q_ids  # (bq,)
-            k_pos = kj * bk + k_ids  # (bk,)
+            k_pos = k_offset + kj * bk + k_ids  # (bk,)
+        if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        if window:
+            w_ok = (q_pos[:, None] - k_pos[None, :]) < window
+            s = jnp.where(w_ok[None, None, None], s, -jnp.inf)
         if has_seg:
             # True -inf: the existing isfinite() guards zero p/alpha for
             # fully cross-document blocks.
@@ -198,6 +209,7 @@ def flash_attention(
     block_q: int = 0,
     block_kv: int = 0,
     segments: Any = None,
+    window: int = 0,
 ) -> jax.Array:
     """Memory-efficient attention; Pallas kernel on TPU, blockwise JAX elsewhere.
 
@@ -220,7 +232,7 @@ def flash_attention(
 
             kernel = functools.partial(
                 pallas_flash_attention, causal=causal, block_q=block_q,
-                block_kv=block_kv,
+                block_kv=block_kv, window=window,
             )
             mesh = current_mesh()
             if mesh is None or all(s == 1 for s in mesh.shape.values()):
@@ -272,5 +284,5 @@ def flash_attention(
     # blockwise_attention is GQA-native (grouped einsums) — no K/V expansion.
     return blockwise_attention(
         q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
-        segments=segments,
+        segments=segments, window=window,
     )

@@ -70,7 +70,27 @@ def _block_sizes(t: int, block_q: int, block_kv: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg):
+def _mask_ok(i0, j0, bq, bk, causal, window, sq_ref, sk_ref):
+    """Combined causal/window/segment validity mask for a (bq, bk) score
+    block at absolute offsets (i0, j0), or None when nothing masks. ONE
+    definition shared by the forward and all three backward kernels — a
+    mask tweak must not silently diverge forward from backward."""
+    ok = None
+    if causal or window:
+        q_pos = i0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = j0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if causal:
+        ok = q_pos >= k_pos
+    if window:
+        w_ok = q_pos - k_pos < window
+        ok = w_ok if ok is None else jnp.logical_and(ok, w_ok)
+    if sq_ref is not None:
+        seg_ok = sq_ref[0] == sk_ref[0]
+        ok = seg_ok if ok is None else jnp.logical_and(ok, seg_ok)
+    return ok
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg, window):
     # `seg` (static) threads document-segment refs: sq (bq, 1) / sk (1, bk)
     # int32 blocks riding the proven trailing-singleton stats layouts; a
     # query may only attend keys of its own document. seg=False traces the
@@ -89,7 +109,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg):
         l_scr[:] = jnp.zeros_like(l_scr)
 
     # Causal: kv block strictly after the q block -> nothing to do.
+    # Sliding window additionally skips blocks entirely BELOW the window
+    # (every key older than window for every query): O(T*W) compute.
     run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
+    if window:
+        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
 
     @pl.when(run)
     def _compute():
@@ -99,23 +123,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk)
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if seg:
-            seg_ok = sq_ref[0] == sk_ref[0]  # (bq, bk)
-            s = jnp.where(seg_ok, s, NEG_INF)
+        ok = _mask_ok(i * bq, j * bk, bq, bk, causal, window,
+                      sq_ref if seg else None, sk_ref if seg else None)
+        if ok is not None:
+            s = jnp.where(ok, s, NEG_INF)
         m_prev = m_scr[:]  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # (bq, bk) f32
-        if seg:
-            # NEG_INF is finite: in a FULLY cross-document block m_new ==
-            # NEG_INF and exp(s - m_new) == 1 for every masked entry (the
-            # causal path never runs such a block, segments do). Zero p by
-            # the mask itself, not by exp underflow.
-            p = jnp.where(seg_ok, p, 0.0)
+        if seg or window:
+            # NEG_INF is finite: a row whose EVERY seen entry is masked
+            # keeps m == NEG_INF, making exp(s - m_new) == 1 for masked
+            # entries (plain causal never runs such a block; window/seg
+            # rows can — early blocks fully below the window, or fully
+            # cross-document). Zero p by the combined mask itself, not by
+            # exp underflow.
+            p = jnp.where(ok, p, 0.0)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:] = m_new
         pv = jax.lax.dot_general(
@@ -142,7 +165,7 @@ def _seg_views(segments: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def _fwd(
     q: jax.Array, k: jax.Array, v: jax.Array, h: int, g: int, *,
     causal: bool, block_q: int, block_kv: int, interpret: bool,
-    segments: Optional[jax.Array] = None,
+    segments: Optional[jax.Array] = None, window: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     bh, t, d = q.shape
     b = bh // h
@@ -153,7 +176,8 @@ def _fwd(
 
     seg = segments is not None
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk, seg=seg
+        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk, seg=seg,
+        window=window,
     )
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda bb, hh, i, j: (bb * h + hh, i, 0)),
@@ -201,7 +225,8 @@ def _fwd(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, causal, scale, bq, bk, nk, seg
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal, scale, bq, bk, nk, seg, window
 ):
     if seg:
         sq_ref, sk_ref, dq_ref, dq_acc = rest
@@ -215,6 +240,8 @@ def _bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
+    if window:
+        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
 
     @pl.when(run)
     def _compute():
@@ -230,17 +257,17 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        ok = _mask_ok(i * bq, j * bk, bq, bk, causal, window,
+                      sq_ref if seg else None, sk_ref if seg else None)
+        if ok is not None:
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
-        if seg:
+        if seg or window:
             # Explicit zero (not exp underflow): lse for a real row is
             # finite, but masked-s NEG_INF is finite too — exp stays ~0
             # there; the guard is for degenerate all-masked rows where
             # lse == NEG_INF would give exp(0) == 1 (see _fwd_kernel).
-            p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)
+            p = jnp.where(ok, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -256,7 +283,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    causal, scale, bq, bk, nq, n_inner, seg
+    causal, scale, bq, bk, nq, n_inner, seg, window
 ):
     if seg:
         sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
@@ -272,6 +299,8 @@ def _bwd_dkv_kernel(
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
+    if window:
+        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
 
     @pl.when(run)
     def _compute():
@@ -285,13 +314,13 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        ok = _mask_ok(i * bq, j * bk, bq, bk, causal, window,
+                      sq_ref if seg else None, sk_ref if seg else None)
+        if ok is not None:
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk)
-        if seg:
-            p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)  # see _bwd_dq_kernel
+        if seg or window:
+            p = jnp.where(ok, p, 0.0)  # see _bwd_dq_kernel
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -313,7 +342,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    causal, scale, n_rep, seg
+    causal, scale, n_rep, seg, window
 ):
     if seg:
         sq_ref, sk_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
@@ -341,13 +370,13 @@ def _bwd_fused_kernel(
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    if causal:
-        q_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    ok = _mask_ok(0, 0, tq, tk, causal, window,
+                  sq_ref if seg else None, sk_ref if seg else None)
+    if ok is not None:
+        s = jnp.where(ok, s, NEG_INF)
     p = jnp.exp(s - lse)
-    if seg:
-        p = jnp.where(sq_ref[0] == sk_ref[0], p, 0.0)  # see _bwd_dq_kernel
+    if seg or window:
+        p = jnp.where(ok, p, 0.0)  # see _bwd_dq_kernel
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -380,7 +409,7 @@ def _bwd_fused_kernel(
 
 def _bwd(
     h: int, g: int, causal: bool, block_q: int, block_kv: int, interpret: bool, residuals, grad,
-    segments: Optional[jax.Array] = None,
+    segments: Optional[jax.Array] = None, window: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     q, k, v, o, lse2 = residuals
     lse = lse2[..., None]
@@ -416,7 +445,8 @@ def _bwd(
             ]
         dq, dk, dv = pl.pallas_call(
             functools.partial(
-                _bwd_fused_kernel, causal=causal, scale=scale, n_rep=n_rep, seg=seg
+                _bwd_fused_kernel, causal=causal, scale=scale, n_rep=n_rep,
+                seg=seg, window=window,
             ),
             grid=(b, g, n_rep),
             in_specs=in_specs,
@@ -453,7 +483,8 @@ def _bwd(
         ]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk, seg=seg
+            _bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk,
+            seg=seg, window=window,
         ),
         grid=(b, h, nq, nk),
         in_specs=dq_in_specs,
@@ -486,7 +517,7 @@ def _bwd(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq,
-            n_inner=n_inner, seg=seg
+            n_inner=n_inner, seg=seg, window=window,
         ),
         grid=(b, g, nk, n_inner),
         in_specs=dkv_in_specs,
@@ -512,14 +543,16 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, h, g, causal, block_q, block_kv, interpret):
-    o, _ = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, h, g, causal, block_q, block_kv, interpret, window):
+    o, _ = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv,
+                interpret=interpret, window=window)
     return o
 
 
-def _flash_fwd(q, k, v, h, g, causal, block_q, block_kv, interpret):
-    o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv, interpret=interpret)
+def _flash_fwd(q, k, v, h, g, causal, block_q, block_kv, interpret, window):
+    o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q, block_kv=block_kv,
+                  interpret=interpret, window=window)
     # Remat tags: under the 'save_qkv_attn'/'save_big' policies the VJP
     # residuals themselves are saved, so the backward never re-runs this
     # kernel (plain 'save_attn' only tags the merged output downstream,
@@ -532,8 +565,9 @@ def _flash_fwd(q, k, v, h, g, causal, block_q, block_kv, interpret):
     return o, (q, k, v, o_res, lse2)
 
 
-def _flash_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):
-    return _bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad)
+def _flash_bwd(h, g, causal, block_q, block_kv, interpret, window, residuals, grad):
+    return _bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad,
+                window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -543,25 +577,27 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # threaded (seg=True). A separate custom_vjp keeps the measured non-segment
 # path's trace byte-identical. `segments` is an int32 primal whose
 # cotangent space is float0 (non-differentiable by construction).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_seg(q, k, v, segments, h, g, causal, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_seg(q, k, v, segments, h, g, causal, block_q, block_kv, interpret, window):
     o, _ = _fwd(q, k, v, h, g, causal=causal, block_q=block_q,
-                block_kv=block_kv, interpret=interpret, segments=segments)
+                block_kv=block_kv, interpret=interpret, segments=segments,
+                window=window)
     return o
 
 
-def _flash_seg_fwd(q, k, v, segments, h, g, causal, block_q, block_kv, interpret):
+def _flash_seg_fwd(q, k, v, segments, h, g, causal, block_q, block_kv, interpret, window):
     o, lse = _fwd(q, k, v, h, g, causal=causal, block_q=block_q,
-                  block_kv=block_kv, interpret=interpret, segments=segments)
+                  block_kv=block_kv, interpret=interpret, segments=segments,
+                  window=window)
     o_res = checkpoint_name(o, "attn_o_res")
     lse2 = checkpoint_name(lse[..., 0], "attn_lse")
     return o, (q, k, v, o_res, lse2, segments)
 
 
-def _flash_seg_bwd(h, g, causal, block_q, block_kv, interpret, residuals, grad):
+def _flash_seg_bwd(h, g, causal, block_q, block_kv, interpret, window, residuals, grad):
     *res, segments = residuals
     dq, dk, dv = _bwd(h, g, causal, block_q, block_kv, interpret, tuple(res),
-                      grad, segments=segments)
+                      grad, segments=segments, window=window)
     dseg = np.zeros(segments.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dseg
 
@@ -579,6 +615,7 @@ def pallas_flash_attention(
     block_kv: int = 0,
     interpret: Optional[bool] = None,
     segments: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Flash attention. q: (B, T, H, Dh); k, v: (B, T, G, Dh) with G | H
     (grouped-query attention — G < H never materializes repeated K/V).
@@ -588,6 +625,10 @@ def pallas_flash_attention(
     the query's own document (packed-sequence training; composed with the
     causal mask inside the kernel — cross-document pairs never contribute
     to the online softmax or its VJP).
+
+    ``window`` > 0 enables SLIDING-WINDOW attention (Mistral-style): each
+    query attends only the last `window` positions. Blocks entirely below
+    the window are skipped (pl.when), so compute is O(T*window).
 
     `interpret=None` auto-selects: compiled on TPU, interpreter elsewhere
     (slow — tests only).
@@ -605,7 +646,8 @@ def pallas_flash_attention(
                 f"segments must be (batch, seq) = ({b}, {t}), got {segments.shape}"
             )
         of = _flash_seg(qf, kf, vf, segments.astype(jnp.int32), h, g, causal,
-                        block_q, block_kv, interpret)
+                        block_q, block_kv, interpret, int(window))
     else:
-        of = _flash(qf, kf, vf, h, g, causal, block_q, block_kv, interpret)
+        of = _flash(qf, kf, vf, h, g, causal, block_q, block_kv, interpret,
+                    int(window))
     return _heads_last(of, b, h)
