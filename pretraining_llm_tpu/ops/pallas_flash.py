@@ -70,6 +70,17 @@ def _block_sizes(t: int, block_q: int, block_kv: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
+def _run_ok(i0, j0, bq, bk, causal, window):
+    """Block-skip predicate for a (bq, bk) score block at offsets (i0, j0):
+    False only when NO (q, k) pair in the block can be valid. Shares a home
+    with _mask_ok for the same reason — skip semantics must never diverge
+    between the forward and backward kernels."""
+    run = jnp.logical_or(not causal, j0 <= i0 + bq - 1)
+    if window:
+        run = jnp.logical_and(run, j0 + bk - 1 >= i0 - (window - 1))
+    return run
+
+
 def _mask_ok(i0, j0, bq, bk, causal, window, sq_ref, sk_ref):
     """Combined causal/window/segment validity mask for a (bq, bk) score
     block at absolute offsets (i0, j0), or None when nothing masks. ONE
@@ -111,9 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, bq, bk, nk, seg, wind
     # Causal: kv block strictly after the q block -> nothing to do.
     # Sliding window additionally skips blocks entirely BELOW the window
     # (every key older than window for every query): O(T*W) compute.
-    run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
-    if window:
-        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
+    run = _run_ok(i * bq, j * bk, bq, bk, causal, window)
 
     @pl.when(run)
     def _compute():
@@ -239,9 +248,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
-    if window:
-        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
+    run = _run_ok(i * bq, j * bk, bq, bk, causal, window)
 
     @pl.when(run)
     def _compute():
@@ -298,9 +305,7 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = jnp.logical_or(not causal, j * bk <= i * bq + bq - 1)
-    if window:
-        run = jnp.logical_and(run, j * bk + bk - 1 >= i * bq - (window - 1))
+    run = _run_ok(i * bq, j * bk, bq, bk, causal, window)
 
     @pl.when(run)
     def _compute():
