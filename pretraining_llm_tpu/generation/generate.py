@@ -447,3 +447,44 @@ def generate_text_batch(
     return [
         t + enc.decode(ids(out[i])) for i, t in enumerate(input_texts)
     ]
+
+
+def generate_text_speculative(
+    model_path: str,
+    draft_model_path: str,
+    input_text: str,
+    max_new_tokens: int = 100,
+    *,
+    k: int = 4,
+    temperature: float = 0.0,
+    seed: int = 0,
+    tokenizer: Optional[str] = None,
+) -> str:
+    """Speculative continuation: a small draft checkpoint proposes k tokens
+    per round, the target verifies them in one forward (see
+    generation.speculative; greedy output is identical to target-only
+    decoding). Both checkpoints must share a vocabulary."""
+    import sys as _sys
+
+    from pretraining_llm_tpu.data.tokenizer import get_tokenizer
+    from pretraining_llm_tpu.generation.speculative import generate_speculative
+
+    params_t, cfg_t = load_model_for_inference(model_path)
+    params_d, cfg_d = load_model_for_inference(draft_model_path)
+    params_t = cast_params_for_inference(params_t, cfg_t.model)
+    params_d = cast_params_for_inference(params_d, cfg_d.model)
+    enc = get_tokenizer(tokenizer or cfg_t.data.tokenizer_name)
+    prompt = np.asarray(enc.encode_ordinary(input_text), np.int32)
+    if len(prompt) == 0:
+        raise ValueError("prompt encodes to zero tokens")
+    out, stats = generate_speculative(
+        params_t, cfg_t.model, params_d, cfg_d.model, prompt[None],
+        max_new_tokens, jax.random.key(seed), k=k, temperature=temperature,
+    )
+    rate = stats["accepted"] / max(stats["proposed"], 1)
+    print(
+        f"[speculative] rounds={stats['rounds']} "
+        f"acceptance={stats['accepted']}/{stats['proposed']} ({rate:.0%})",
+        file=_sys.stderr,
+    )
+    return input_text + enc.decode(np.asarray(out).tolist())

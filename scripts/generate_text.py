@@ -46,7 +46,34 @@ def main() -> None:
         "--stop_token", type=int, default=None,
         help="token id that ends a row's generation (output truncates there)",
     )
+    parser.add_argument(
+        "--draft_model_path", default=None,
+        help="a smaller checkpoint sharing the vocab: enables speculative "
+        "decoding (draft proposes --spec_k tokens/round, target verifies "
+        "in one forward; greedy output equals target-only decoding)",
+    )
+    parser.add_argument("--spec_k", type=int, default=4,
+                        help="speculative proposals per round")
     args = parser.parse_args()
+
+    if args.draft_model_path:
+        from pretraining_llm_tpu.generation.generate import (
+            generate_text_speculative,
+        )
+
+        if args.input_file:
+            parser.error("--draft_model_path is the batch-1 latency path; "
+                         "use --input_text")
+        if args.stop_token is not None or args.top_k or args.top_p:
+            parser.error("--draft_model_path supports --temperature only "
+                         "(no stop_token/top_k/top_p yet)")
+        print(generate_text_speculative(
+            args.model_path, args.draft_model_path, args.input_text,
+            args.max_new_tokens, k=args.spec_k,
+            temperature=args.temperature, seed=args.seed,
+            tokenizer=args.tokenizer,
+        ))
+        return
 
     if args.input_file:
         from pretraining_llm_tpu.generation.generate import generate_text_batch
