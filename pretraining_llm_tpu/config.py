@@ -441,6 +441,12 @@ class TrainConfig:
     adam_b2: float = 0.95
     adam_eps: float = 1e-8
     grad_clip: float = 1.0  # 0 disables
+    # Exponential moving average of the params (0 = off): a fp32 shadow
+    # updated after every optimizer step (ema = d*ema + (1-d)*params),
+    # stored at state["ema"], checkpointed/sharded like the params.
+    # Consume via `evaluate.py --ema`, `generate_text.py --ema`, or the
+    # `--ema` flag on the torch/HF exporters. Typical d: 0.999-0.9999.
+    ema_decay: float = 0.0
     seed: int = 0
     checkpoint_dir: str = "checkpoints"
     checkpoint_interval: int = 1000  # reference saves only once at the end
@@ -469,6 +475,10 @@ class TrainConfig:
             raise ValueError(
                 "optimizer must be 'adamw', 'adafactor', or 'muon', "
                 f"got {self.optimizer!r}"
+            )
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1), got {self.ema_decay}"
             )
         if self.batch_size % self.microbatches != 0:
             raise ValueError(

@@ -316,8 +316,14 @@ def shard_params_for_inference(params: Any, mesh: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def load_model_for_inference(model_path: str) -> Tuple[Any, Config]:
-    """Load params + config from a framework checkpoint directory."""
+def load_model_for_inference(
+    model_path: str, *, use_ema: bool = False
+) -> Tuple[Any, Config]:
+    """Load params + config from a framework checkpoint directory.
+
+    ``use_ema=True`` loads the exponential-moving-average shadow instead of
+    the raw params (requires the run to have trained with
+    `train.ema_decay > 0`; fails loudly otherwise)."""
     from pretraining_llm_tpu.training import checkpoint as ckpt
 
     path = model_path
@@ -329,16 +335,26 @@ def load_model_for_inference(model_path: str) -> Tuple[Any, Config]:
     with open(f"{path}/metadata.json") as f:
         meta = json.load(f)
     cfg = Config.from_json(json.dumps(meta["extra"]["config"]))
+    key = "ema" if use_ema else "params"
     # Shape-only template: no throwaway init of the full model.
     template = jax.eval_shape(
-        lambda: {"params": transformer.init_params(cfg.model, jax.random.key(0))}
+        lambda: {key: transformer.init_params(cfg.model, jax.random.key(0))}
     )
-    restored, _ = ckpt.load_checkpoint(path, template)
+    try:
+        restored, _ = ckpt.load_checkpoint(path, template)
+    except ValueError as e:
+        if use_ema and "missing leaves" in str(e):
+            raise ValueError(
+                f"checkpoint {path} has no EMA shadow (the run trained "
+                "with train.ema_decay=0); drop --ema or retrain with "
+                "ema_decay > 0"
+            ) from e
+        raise
     # NOTE: returns the RAW checkpoint dtypes — callers that only run the
     # forward should apply cast_params_for_inference (the generation CLIs
     # below do); callers that re-export weights (export_torch_checkpoint)
     # need the fp32 masters untouched.
-    return jax.device_put(restored["params"]), cfg
+    return jax.device_put(restored[key]), cfg
 
 
 def generate_text(
@@ -352,6 +368,7 @@ def generate_text(
     seed: int = 0,
     tokenizer: Optional[str] = None,
     stop_token: Optional[int] = None,
+    ema: bool = False,
 ) -> str:
     """Mirror of the reference's `generate_text(model_path, input_text,
     max_new_tokens)` (generate_text.py:7): checkpoint -> text continuation.
@@ -368,6 +385,7 @@ def generate_text(
         seed=seed,
         tokenizer=tokenizer,
         stop_token=stop_token,
+        ema=ema,
     )[0]
 
 
@@ -382,6 +400,7 @@ def generate_text_batch(
     seed: int = 0,
     tokenizer: Optional[str] = None,
     stop_token: Optional[int] = None,
+    ema: bool = False,
 ) -> list:
     """Batched continuation of DIFFERENT-length prompts in one compiled
     ragged decode (`generate(..., prompt_lengths=...)`) — one device
@@ -392,7 +411,7 @@ def generate_text_batch(
 
     if not input_texts:
         raise ValueError("input_texts is empty (nothing to generate)")
-    params, cfg = load_model_for_inference(model_path)
+    params, cfg = load_model_for_inference(model_path, use_ema=ema)
     # Serving prep: bf16 matmul weights (bit-identical forward — see
     # cast_params_for_inference); the fp32 tree is dropped here, halving
     # param HBM and the per-step weight reads for the generation CLIs.

@@ -40,11 +40,22 @@ TrainState = Dict[str, Any]
 
 def init_train_state(cfg: Config, key: jax.Array) -> TrainState:
     params = transformer.init_params(cfg.model, key)
-    return {
+    state = {
         "params": params,
         "opt": opt.optimizer_init(params, cfg.train),
         "step": jnp.zeros((), jnp.int32),
     }
+    if cfg.train.ema_decay > 0:
+        # Exponential moving average of the params for evaluation/serving
+        # (beyond-reference): fp32 shadow updated after every optimizer
+        # step; checkpointed and sharded exactly like the params.
+        # copy=True: fp32 params' astype would alias the SAME buffer,
+        # and the jitted step donates the state — donating params and
+        # ema as one buffer is an XLA error (and would be wrong anyway).
+        state["ema"] = jax.tree.map(
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params
+        )
+    return state
 
 
 def state_pspec_tree(
@@ -80,11 +91,14 @@ def state_pspec_tree(
             "nu": param_pspec_tree(state["opt"]["nu"], pipeline, **kw),
             "count": P(),
         }
-    return {
+    out = {
         "params": pspecs,
         "opt": opt_pspecs,
         "step": P(),
     }
+    if "ema" in state:
+        out["ema"] = param_pspec_tree(state["ema"], pipeline, **kw)
+    return out
 
 
 def _tensor_size(mesh: Optional[Mesh]) -> int:
@@ -141,6 +155,9 @@ def bake_state_layout(state: TrainState, cfg: Config, forward: bool = True) -> T
             if isinstance(sub, dict) and "blocks" in sub:
                 out["opt"][m] = dict(sub)
                 out["opt"][m]["blocks"] = f(sub["blocks"], s, v)
+    if "ema" in state:
+        out["ema"] = dict(state["ema"])
+        out["ema"]["blocks"] = f(state["ema"]["blocks"], s, v)
     return out
 
 
@@ -199,6 +216,12 @@ def _make_step_fn(cfg: Config, mesh: Optional[Mesh] = None):
             grads, state["opt"], state["params"], lr, tcfg
         )
         new_state = {"params": new_params, "opt": new_opt, "step": state["step"] + 1}
+        if "ema" in state:
+            d = tcfg.ema_decay
+            new_state["ema"] = jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p.astype(jnp.float32),
+                state["ema"], new_params,
+            )
         metrics = {"loss": loss, "grad_norm": grad_norm, "lr": lr}
         return new_state, metrics
 

@@ -138,7 +138,25 @@ class Trainer:
             template = jax.eval_shape(
                 lambda: ts.init_train_state(config, jax.random.key(tcfg.seed))
             )
-            state, extra = ckpt.load_checkpoint(latest, template)
+            try:
+                state, extra = ckpt.load_checkpoint(latest, template)
+            except ValueError as e:
+                if "ema" in template and "missing leaves: ['ema" in str(e):
+                    # ema_decay was turned ON mid-run: the old checkpoints
+                    # carry no shadow. Load without it and seed the shadow
+                    # from the restored params (exactly what a fresh
+                    # init_train_state does) instead of dying.
+                    no_ema = {k: v for k, v in template.items() if k != "ema"}
+                    state, extra = ckpt.load_checkpoint(latest, no_ema)
+                    state["ema"] = jax.tree.map(
+                        lambda p: np.array(p, dtype=np.float32, copy=True),
+                        state["params"],
+                    )
+                    self.logger.log({
+                        "event": "ema_seeded_from_params", "from": latest,
+                    })
+                else:
+                    raise
             # Migration guard: checkpoints written by this trainer are always
             # depth-major (save de-interleaves a baked state); a checkpoint
             # carrying the interleaved layout (e.g. a raw dump of a baked

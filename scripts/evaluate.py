@@ -35,6 +35,8 @@ def main() -> None:
     ap.add_argument("--data", required=True, help="uint16 token .bin")
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--batch", type=int, default=0, help="0 = checkpoint's train batch")
+    ap.add_argument("--ema", action="store_true",
+                    help="evaluate the EMA shadow params (train.ema_decay runs)")
     ap.add_argument(
         "--seed", type=int, default=-1,
         help="-1 = the trainer's own eval seed (data.sample_seed + 104729), "
@@ -48,7 +50,7 @@ def main() -> None:
     from pretraining_llm_tpu.generation.generate import load_model_for_inference
     from pretraining_llm_tpu.training import train_step as ts
 
-    params, cfg = load_model_for_inference(args.model_path)
+    params, cfg = load_model_for_inference(args.model_path, use_ema=args.ema)
     batch = args.batch or cfg.train.batch_size
     seed = args.seed if args.seed >= 0 else cfg.data.sample_seed + 104729
     it = loader.get_batch_iterator(args.data, batch, cfg.model.context_length, seed=seed)

@@ -122,11 +122,13 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("checkpoint", help="framework checkpoint directory")
     ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--ema", action="store_true",
+                    help="export the EMA shadow params instead of the raw params")
     args = ap.parse_args()
 
     from pretraining_llm_tpu.generation.generate import load_model_for_inference
 
-    params, cfg = load_model_for_inference(args.checkpoint)
+    params, cfg = load_model_for_inference(args.checkpoint, use_ema=args.ema)
     model = export_params_to_hf(params, cfg.model)
     model.save_pretrained(args.out_dir)
     n = sum(p.numel() for p in model.parameters())

@@ -115,13 +115,15 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("checkpoint", help="framework checkpoint dir (or step-N dir)")
     ap.add_argument("--out", required=True, help="output .pt path")
+    ap.add_argument("--ema", action="store_true",
+                    help="export the EMA shadow params instead of the raw params")
     args = ap.parse_args()
 
     import torch
 
     from pretraining_llm_tpu.generation.generate import load_model_for_inference
 
-    params, cfg = load_model_for_inference(args.checkpoint)
+    params, cfg = load_model_for_inference(args.checkpoint, use_ema=args.ema)
     sd = export_params(cfg.model, params)
     torch.save(
         {

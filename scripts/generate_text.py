@@ -54,6 +54,8 @@ def main() -> None:
     )
     parser.add_argument("--spec_k", type=int, default=4,
                         help="speculative proposals per round")
+    parser.add_argument("--ema", action="store_true",
+                        help="decode from the EMA shadow params")
     args = parser.parse_args()
 
     if args.draft_model_path:
@@ -64,9 +66,9 @@ def main() -> None:
         if args.input_file:
             parser.error("--draft_model_path is the batch-1 latency path; "
                          "use --input_text")
-        if args.stop_token is not None or args.top_k or args.top_p:
+        if args.stop_token is not None or args.top_k or args.top_p or args.ema:
             parser.error("--draft_model_path supports --temperature only "
-                         "(no stop_token/top_k/top_p yet)")
+                         "(no stop_token/top_k/top_p/ema yet)")
         print(generate_text_speculative(
             args.model_path, args.draft_model_path, args.input_text,
             args.max_new_tokens, k=args.spec_k,
@@ -90,6 +92,7 @@ def main() -> None:
             seed=args.seed,
             tokenizer=args.tokenizer,
             stop_token=args.stop_token,
+            ema=args.ema,
         )
         for text in outs:
             print(text)
@@ -106,6 +109,7 @@ def main() -> None:
         seed=args.seed,
         tokenizer=args.tokenizer,
         stop_token=args.stop_token,
+        ema=args.ema,
     )
     print(text)
 
