@@ -85,6 +85,11 @@ class ModelConfig:
     # head memory for zero recompute FLOPs (the right trade at small batch
     # or remat="none").
     ce_impl: str = "chunked"  # chunked | fused | dense
+    # z-loss coefficient (PaLM/ST-MoE): adds z * mean(logsumexp(logits)^2)
+    # to the training loss, pinning the softmax normalizer near 0 —
+    # stabilizes large-scale bf16 training. 0 = off. chunked/dense CE
+    # heads only (the fused Pallas kernel does not implement it).
+    z_loss_coef: float = 0.0
     # Unroll factor for the depth scan (1 = fully rolled). Unrolling lets XLA
     # fuse across layer boundaries at the cost of compile time.
     scan_unroll: int = 1
@@ -226,6 +231,13 @@ class ModelConfig:
             raise ValueError(
                 "pipeline parallelism does not compose with sequence/context "
                 "parallelism (ring/ulysses attention or sequence_parallel)"
+            )
+        if self.z_loss_coef < 0:
+            raise ValueError("z_loss_coef must be >= 0")
+        if self.z_loss_coef > 0 and self.ce_impl == "fused":
+            raise ValueError(
+                "z_loss_coef requires ce_impl='chunked' or 'dense' (the "
+                "fused Pallas CE kernel does not implement the z term)"
             )
         if self.sliding_window < 0:
             raise ValueError("sliding_window must be >= 0 (0 = full causal)")

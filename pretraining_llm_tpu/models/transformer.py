@@ -648,6 +648,7 @@ def _chunked_ce(
     bias: Optional[jax.Array],
     targets: jax.Array,
     cfg: ModelConfig,
+    z: float = 0.0,
 ) -> jax.Array:
     """Mean cross-entropy head dispatcher (chunked | fused | dense).
 
@@ -736,7 +737,7 @@ def _chunked_ce(
         # (tested) — the forward LOSS value is computed from f32-accum
         # logits either way and matches exactly.
         return _dense_lse_ce(
-            hidden.reshape(s, d), w_out, bias, targets.reshape(s), cdt
+            hidden.reshape(s, d), w_out, bias, targets.reshape(s), cdt, z=z
         ) / s
     # Chunk only when the fp32 logits buffer is big enough to matter (XLA
     # already fuses the small-head case well — measured neutral-to-slower to
@@ -766,7 +767,7 @@ def _chunked_ce(
             )
     xs = hidden.reshape(n_chunks, s // n_chunks, d)
     ts_ = targets.reshape(n_chunks, s // n_chunks)
-    return _lse_saved_ce(xs, w_out, bias, ts_, cdt) / s
+    return _lse_saved_ce(xs, w_out, bias, ts_, cdt, z=z) / s
 
 
 def _head_logits32(xc, wc, bias, cdt):
@@ -782,7 +783,7 @@ def _head_logits32(xc, wc, bias, cdt):
     return logits
 
 
-def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
+def _lse_saved_ce(xs, w_out, bias, ts_, cdt, z=0.0):
     """Sum of per-token CE over chunked logits, custom VJP.
 
     vs `lax.scan(jax.checkpoint(chunk))`: the checkpointed backward re-runs
@@ -814,7 +815,13 @@ def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
             logits = logits_of(xc, wc, bias)
             lse = jax.nn.logsumexp(logits, axis=-1)
             label_logit = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-            return carry + jnp.sum(lse - label_logit), lse
+            total = jnp.sum(lse - label_logit)
+            if z:
+                # z-loss (PaLM/ST-MoE): z * lse^2 keeps softmax logits from
+                # drifting (lse ~ 0 means calibrated normalizers; also
+                # guards bf16 logit overflow at scale).
+                total = total + z * jnp.sum(jnp.square(lse))
+            return carry + total, lse
 
         total, lses = jax.lax.scan(chunk, jnp.zeros((), jnp.float32), (xs, ts_))
         return total, (xs, w_out, bias, lses)
@@ -830,6 +837,9 @@ def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
             xc, tc, lse = inp
             logits = logits_of(xc, wc, bias)
             p = jnp.exp(logits - lse[:, None])  # softmax, one pass
+            if z:
+                # d(lse^2)/dlogits = 2*lse*softmax -> fold into p's scale.
+                p = p * (1.0 + 2.0 * z * lse[:, None])
             dlogits = (p.at[jnp.arange(sc), tc].add(-1.0)) * g  # fp32
             dx = jnp.einsum(
                 "sv,dv->sd", dlogits, wc, preferred_element_type=jnp.float32
@@ -853,7 +863,7 @@ def _lse_saved_ce(xs, w_out, bias, ts_, cdt):
     return ce(xs, w_out, bias)
 
 
-def _dense_lse_ce(x, w_out, bias, ts_, cdt):
+def _dense_lse_ce(x, w_out, bias, ts_, cdt, z=0.0):
     """Sum of per-token CE with SAVED logits — no backward recompute.
 
     Custom VJP saving (compute-dtype logits, f32 lse): forward computes the
@@ -873,6 +883,8 @@ def _dense_lse_ce(x, w_out, bias, ts_, cdt):
         lse = jax.nn.logsumexp(logits, axis=-1)
         label_logit = jnp.take_along_axis(logits, ts_[:, None], axis=-1)[:, 0]
         total = jnp.sum(lse - label_logit)
+        if z:
+            total = total + z * jnp.sum(jnp.square(lse))  # see _lse_saved_ce
         # Save in compute dtype: halves the residual vs f32 at bf16-rounding
         # cost in backward only (the fp32 loss above is already computed).
         return total, (x, w_out, bias, logits.astype(cdt), lse)
@@ -880,6 +892,8 @@ def _dense_lse_ce(x, w_out, bias, ts_, cdt):
     def _bwd(res, g):
         x, w_out, bias, logits_c, lse = res
         p = jnp.exp(logits_c.astype(jnp.float32) - lse[:, None])
+        if z:
+            p = p * (1.0 + 2.0 * z * lse[:, None])  # see _lse_saved_ce
         dlogits = (p.at[jnp.arange(sc), ts_].add(-1.0)) * g  # fp32
         dx = jnp.einsum(
             "sv,dv->sd", dlogits, w_out.astype(cdt),
@@ -960,7 +974,13 @@ def loss_fn(
         return_aux=True, return_pre_logits=True, blocks_baked=blocks_baked,
     )
     w_out, bias = _lm_head_weights(params, cfg)
-    loss = _chunked_ce(hidden, w_out, bias, targets, cfg)
+    # z-loss is part of the TRAINING objective only — include_aux=False
+    # (eval) keeps reported val_loss pure cross-entropy, exactly like the
+    # MoE router aux term.
+    loss = _chunked_ce(
+        hidden, w_out, bias, targets, cfg,
+        z=cfg.z_loss_coef if include_aux else 0.0,
+    )
     if cfg.n_experts and include_aux:
         loss = loss + cfg.router_aux_coef * aux
     return loss
