@@ -104,7 +104,8 @@ def _bucket_len(prompt_len: int, ctx: int, max_new_tokens: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p", "mesh"),
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p",
+                     "min_p", "mesh"),
 )
 def _generate_jit(
     params: Any,
@@ -116,6 +117,7 @@ def _generate_jit(
     temperature: float,
     top_k: Optional[int],
     top_p: Optional[float],
+    min_p: Optional[float] = None,
     mesh: Any = None,
     prompt_lengths: Optional[jax.Array] = None,  # (B,) int32 — ragged rows
     stop_token: Optional[jax.Array] = None,  # () int32 — traced, no recompile per id
@@ -173,7 +175,8 @@ def _generate_jit(
             )
             start_index = jnp.int32(bucket)
         next_tok = sample_logits(
-            last, sub, temperature=temperature, top_k=top_k, top_p=top_p
+            last, sub, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p,
         )
 
         def decode_step(carry, _):
@@ -184,7 +187,8 @@ def _generate_jit(
             )
             key, sub = jax.random.split(key)
             nxt = sample_logits(
-                logits[:, 0], sub, temperature=temperature, top_k=top_k, top_p=top_p
+                logits[:, 0], sub, temperature=temperature, top_k=top_k,
+                top_p=top_p, min_p=min_p,
             )
             if stop_token is not None:
                 # A finished row keeps emitting its stop token: the scan
@@ -214,6 +218,7 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     mesh: Any = None,
     prompt_lengths: Optional[Any] = None,
     stop_token: Optional[int] = None,
@@ -295,7 +300,7 @@ def generate(
     stop = jnp.int32(stop_token) if stop_token is not None else None
     return _generate_jit(
         params, prompt, jnp.int32(prompt_len), key, cfg, max_new_tokens,
-        temperature, top_k, top_p, mesh, lengths, stop,
+        temperature, top_k, top_p, min_p, mesh, lengths, stop,
     )
 
 
@@ -365,6 +370,7 @@ def generate_text(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     seed: int = 0,
     tokenizer: Optional[str] = None,
     stop_token: Optional[int] = None,
@@ -382,6 +388,7 @@ def generate_text(
         temperature=temperature,
         top_k=top_k,
         top_p=top_p,
+        min_p=min_p,
         seed=seed,
         tokenizer=tokenizer,
         stop_token=stop_token,
@@ -397,6 +404,7 @@ def generate_text_batch(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     seed: int = 0,
     tokenizer: Optional[str] = None,
     stop_token: Optional[int] = None,
@@ -452,6 +460,7 @@ def generate_text_batch(
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            min_p=min_p,
             prompt_lengths=use_lengths,
             stop_token=stop_token,
         )

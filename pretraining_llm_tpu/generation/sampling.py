@@ -1,8 +1,11 @@
-"""Token sampling: temperature / top-k / top-p, pure and jittable.
+"""Token sampling: temperature / top-k / top-p / min-p, pure and jittable.
 
 The reference samples with temperature-1 multinomial only
 (`/root/reference/src/models/transformer.py:110-113`). That remains the
-default; top-k and nucleus sampling are the standard extensions.
+default; top-k, nucleus (top-p), and min-p sampling are the standard
+extensions (min-p keeps tokens with prob >= min_p * max_prob — the
+support adapts to the distribution's confidence instead of a fixed mass
+or count).
 """
 
 from __future__ import annotations
@@ -20,12 +23,18 @@ def sample_logits(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
 ) -> jax.Array:
     """Sample token ids from (B, V) logits. temperature=0 -> greedy."""
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
+    if min_p is not None and 0.0 < min_p <= 1.0:
+        # Keep tokens whose prob >= min_p * max prob. In logit space:
+        # logit >= max_logit + log(min_p) — no softmax materialization.
+        cutoff = jnp.max(logits, axis=-1, keepdims=True) + jnp.log(min_p)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     if top_k is not None and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)

@@ -37,6 +37,8 @@ def main() -> None:
     parser.add_argument("--temperature", type=float, default=1.0, help="0 = greedy")
     parser.add_argument("--top_k", type=int, default=None)
     parser.add_argument("--top_p", type=float, default=None)
+    parser.add_argument("--min_p", type=float, default=None,
+                        help="keep tokens with prob >= min_p * max prob")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--tokenizer", default=None,
@@ -89,6 +91,7 @@ def main() -> None:
             temperature=args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,
+            min_p=args.min_p,
             seed=args.seed,
             tokenizer=args.tokenizer,
             stop_token=args.stop_token,
@@ -106,6 +109,7 @@ def main() -> None:
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
+        min_p=args.min_p,
         seed=args.seed,
         tokenizer=args.tokenizer,
         stop_token=args.stop_token,

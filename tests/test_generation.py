@@ -90,6 +90,27 @@ def test_sampling_top_k_restricts_support():
     assert draws <= {3, 4}
 
 
+def test_sampling_min_p_restricts_support():
+    """min-p keeps exactly the tokens with prob >= min_p * max prob, and
+    the support adapts to confidence (peaked dist -> smaller support)."""
+    logits = jnp.asarray([[3.0, 2.9, 0.0, -5.0]])
+    ids = [
+        int(sample_logits(jnp.asarray(logits), jax.random.key(i),
+                          temperature=1.0, min_p=0.5)[0])
+        for i in range(64)
+    ]
+    # p(2.9)/p(3.0) = e^-0.1 ~ 0.90 >= 0.5 kept; p(0)/p(3) ~ 0.05 < 0.5 cut
+    assert set(ids) <= {0, 1}
+    assert len(set(ids)) == 2  # both survivors actually sampled
+    peaked = jnp.asarray([[10.0, 2.9, 0.0, -5.0]])
+    ids_p = [
+        int(sample_logits(peaked, jax.random.key(i), temperature=1.0,
+                          min_p=0.5)[0])
+        for i in range(32)
+    ]
+    assert set(ids_p) == {0}  # confident dist -> support collapses
+
+
 def test_sampling_top_p_restricts_support():
     # Peaked distribution: token 0 carries ~88% of the mass.
     logits = jnp.asarray([[5.0, 3.0, 0.0, -1.0, -2.0]])
