@@ -427,7 +427,7 @@ class DataConfig:
 # Training
 # ---------------------------------------------------------------------------
 
-_LR_SCHEDULES = ("warmup_constant", "warmup_cosine")
+_LR_SCHEDULES = ("warmup_constant", "warmup_cosine", "warmup_stable_decay")
 
 
 @dataclass(frozen=True)
@@ -447,7 +447,12 @@ class TrainConfig:
     optimizer: str = "adamw"
     muon_momentum: float = 0.95  # muon only: nesterov momentum coefficient
     warmup_frac: float = 0.1
-    min_lr_frac: float = 0.1  # cosine floor as a fraction of lr
+    min_lr_frac: float = 0.1  # cosine/decay floor as a fraction of lr
+    # warmup_stable_decay (WSD) only: fraction of train_steps spent in the
+    # final linear decay phase (warmup -> constant lr -> linear to
+    # min_lr_frac*lr). The stable phase makes mid-run checkpoints
+    # continuation-friendly (no cosine horizon baked in).
+    decay_frac: float = 0.1
     weight_decay: float = 0.1
     adam_b1: float = 0.9
     adam_b2: float = 0.95
@@ -487,6 +492,10 @@ class TrainConfig:
             raise ValueError(
                 "optimizer must be 'adamw', 'adafactor', or 'muon', "
                 f"got {self.optimizer!r}"
+            )
+        if not 0.0 < self.decay_frac <= 1.0:
+            raise ValueError(
+                f"decay_frac must be in (0, 1], got {self.decay_frac}"
             )
         if not 0.0 <= self.ema_decay < 1.0:
             raise ValueError(

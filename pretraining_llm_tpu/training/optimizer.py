@@ -405,14 +405,30 @@ def optimizer_update(
 
 def learning_rate(step: jax.Array, cfg: TrainConfig) -> jax.Array:
     """LR schedule. The reference uses 10%-warmup-then-constant
-    (train_transformer.py:43-49); warmup+cosine is the pretraining default."""
+    (train_transformer.py:43-49); warmup+cosine is the pretraining default;
+    warmup_stable_decay (WSD) holds lr constant after warmup then decays
+    linearly over the final decay_frac of the run — mid-run checkpoints
+    carry no cosine horizon, so runs extend/branch cleanly."""
     s = step.astype(jnp.float32)
     warmup = jnp.maximum(cfg.warmup_frac * cfg.train_steps, 1.0)
     warm_lr = cfg.lr * (s + 1.0) / warmup
     if cfg.lr_schedule == "warmup_constant":
         return jnp.minimum(warm_lr, cfg.lr)
-    # warmup_cosine
     min_lr = cfg.lr * cfg.min_lr_frac
+    if cfg.lr_schedule == "warmup_stable_decay":
+        # Clamp to the warmup boundary: decay_frac ~ 1.0 must not put the
+        # decay start INSIDE warmup (an instant LR cliff at the handoff).
+        decay_start = jnp.maximum(
+            cfg.train_steps * (1.0 - cfg.decay_frac), warmup
+        )
+        frac = jnp.clip(
+            (s - decay_start)
+            / jnp.maximum(cfg.train_steps - decay_start, 1.0),
+            0.0, 1.0,
+        )
+        stable_or_decay = cfg.lr + (min_lr - cfg.lr) * frac
+        return jnp.where(s < warmup, warm_lr, stable_or_decay)
+    # warmup_cosine
     progress = jnp.clip((s - warmup) / jnp.maximum(cfg.train_steps - warmup, 1.0), 0.0, 1.0)
     cos_lr = min_lr + 0.5 * (cfg.lr - min_lr) * (1.0 + jnp.cos(jnp.pi * progress))
     return jnp.where(s < warmup, warm_lr, cos_lr)

@@ -151,6 +151,29 @@ def _tiny_adafactor_cfg(**train_kw):
     )
 
 
+def test_wsd_schedule_shape():
+    """WSD: linear warmup -> flat at lr -> linear decay to min_lr over the
+    final decay_frac of the run."""
+    cfg = TrainConfig(lr=1.0, lr_schedule="warmup_stable_decay",
+                      train_steps=1000, warmup_frac=0.1, decay_frac=0.2,
+                      min_lr_frac=0.1)
+    lr = lambda s: float(opt.learning_rate(jnp.asarray(s), cfg))
+    assert lr(0) < 0.02                     # warmup start
+    assert abs(lr(99) - 1.0) < 0.02         # warmup end
+    assert lr(400) == 1.0 == lr(799)        # stable plateau
+    assert 0.1 < lr(900) < 1.0              # mid-decay
+    assert abs(lr(1000) - 0.1) < 1e-6       # floor
+    # plateau really is flat (no cosine curvature)
+    assert lr(500) == lr(700)
+    # decay_frac ~ 1.0: decay start clamps to the warmup boundary — no LR
+    # cliff at the handoff (continuous through the boundary).
+    cfg_full = TrainConfig(lr=1.0, lr_schedule="warmup_stable_decay",
+                           train_steps=1000, warmup_frac=0.1, decay_frac=1.0,
+                           min_lr_frac=0.1)
+    lrf = lambda s: float(opt.learning_rate(jnp.asarray(s), cfg_full))
+    assert abs(lrf(100) - lrf(99)) < 0.02
+
+
 def test_adafactor_state_shapes_and_size():
     """Factoring rule: >=3-D and top-level 2-D leaves are factored over the
     last two axes (leading axes kept — the interleave baking permutes axis
