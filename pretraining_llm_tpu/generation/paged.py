@@ -1,0 +1,229 @@
+"""Paged KV cache: block pool + tables for continuous-batching serving.
+
+The contiguous cache (`models.transformer.make_kv_cache`) sizes every row
+for the worst case and fixes the batch at compile time — fine for offline
+generation, wasteful for serving, where requests of wildly different
+lengths come and go. The paged layout decouples memory from batch rows:
+
+  - K/V live in a shared POOL of fixed-size blocks
+    ((L, n_blocks, block_size, G, Dh), `make_paged_kv_pool`);
+  - each live request owns an ordered list of pool block ids — a row of
+    the int32 ``block_tables`` — plus its logical length in ``seq_lens``;
+  - the decode program (`paged_decode_step`) is compiled ONCE for the
+    engine's (max_batch, max_blocks) shape: admission, growth, and
+    eviction only edit int32 tables host-side.
+
+This is vLLM's PagedAttention memory model re-expressed for XLA: block
+tables are gather/scatter indices into statically-shaped pools, not
+pointers (the CUDA kernel's pointer-chasing would defeat XLA tiling).
+Attention reads ride one `pool[tables]` gather per layer — the same HBM
+bytes the dense ragged-decode path reads for an equal total length.
+
+The reference has no serving path at all (generate is batch-1, fixed
+count: /root/reference/src/models/transformer.py:96-114); this module +
+`generation.serving` are beyond-reference capability.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.generation.sampling import sample_logits
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.models.transformer import PagedInfo
+
+# Pool-key names <- their contiguous-cache counterparts (prefill writes a
+# dense per-request cache, then scatters its pages into the pools).
+_POOL_OF_DENSE = {
+    "k": "k_pool",
+    "v": "v_pool",
+    "k_scale": "k_scale_pool",
+    "v_scale": "v_scale_pool",
+}
+
+
+def required_blocks(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache slots."""
+    return -(-n_tokens // block_size)
+
+
+def check_paged_bounds(block_tables, seq_lens, block_size: int) -> None:
+    """Host-side guard for the PagedInfo capacity invariant: a decode step
+    WRITES slot seq_len, so seq_len == max_blocks*block_size would clamp
+    the page index onto the row's LAST table entry and silently overwrite
+    a live block (jit gathers clamp, they don't raise). Call before
+    dispatching paged_decode_step whenever you build tables yourself."""
+    import numpy as np
+
+    tables = np.asarray(block_tables)
+    seq = np.asarray(seq_lens)
+    cap = tables.shape[-1] * block_size
+    if (seq >= cap).any() or (seq < 0).any():
+        bad = np.nonzero((seq >= cap) | (seq < 0))[0].tolist()
+        raise ValueError(
+            f"paged rows {bad} violate 0 <= seq_len < capacity={cap}: a "
+            f"step would overwrite a live block (seq_lens={seq[bad]})"
+        )
+
+
+class BlockAllocator:
+    """Host-side free-list over pool block ids. Block 0 is reserved as the
+    idle-row scratch target (see make_paged_kv_pool) and never handed out.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need n_blocks >= 2 (block 0 is reserved)")
+        self.n_blocks = n_blocks
+        # LIFO free list: recently-freed blocks are reused first, keeping
+        # the hot working set of pool pages small.
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._live: set = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n block ids, or None if the pool cannot cover them (all-or-
+        nothing: a partial grant would deadlock admission)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        for i in ids:
+            if i not in self._live:
+                raise ValueError(f"double free / foreign block id {i}")
+            self._live.discard(i)
+            self._free.append(i)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pages",), donate_argnums=(0,))
+def _scatter_pages(
+    pools: transformer.KVCache,
+    dense_cache: transformer.KVCache,
+    block_ids: jax.Array,  # (n_pages,) int32
+    n_pages: int,
+) -> transformer.KVCache:
+    """Scatter a (L, 1, n_pages*bs, ...) dense prefill cache into the pools
+    at ``block_ids``. Donated pools: the update is in-place on device."""
+    out = dict(pools)
+    for dense_key, pool_key in _POOL_OF_DENSE.items():
+        if dense_key not in dense_cache:
+            continue
+        buf = dense_cache[dense_key][:, 0]  # (L, n_pages*bs, ...)
+        tail = buf.shape[2:]
+        pages = buf.reshape((buf.shape[0], n_pages, -1) + tail)
+        out[pool_key] = pools[pool_key].at[:, block_ids].set(
+            pages.astype(pools[pool_key].dtype)
+        )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "p_bucket"))
+def _prefill_dense(
+    params: Any,
+    prompt: jax.Array,  # (1, p_bucket) int32, zero-padded
+    prompt_len: jax.Array,  # () int32 — true length, traced
+    cfg: ModelConfig,
+    p_bucket: int,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """One causal forward over the padded prompt into a fresh dense cache
+    sized exactly p_bucket. Returns (last real token's logits (V,), cache).
+
+    Pad slots >= prompt_len hold garbage K/V, but in the paged layout the
+    decode mask only exposes linear index j once j <= seq_len — and the
+    decode write to slot seq_len lands BEFORE the mask exposes it, exactly
+    the dense-prefill overwrite discipline (`generate._generate_jit`).
+    """
+    cache = transformer.make_kv_cache(cfg, 1, p_bucket)
+    logits, cache = transformer.forward(
+        params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
+    )
+    idx = jnp.broadcast_to(
+        (prompt_len - 1).astype(jnp.int32), (1, 1, logits.shape[-1])
+    )
+    last = jnp.take_along_axis(logits, idx, axis=1)[0, 0]
+    return last, cache
+
+
+def prefill_into_pool(
+    params: Any,
+    cfg: ModelConfig,
+    pools: transformer.KVCache,
+    prompt_ids: Sequence[int],
+    block_ids: Sequence[int],
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """Prefill one prompt and write its pages into the pool.
+
+    ``block_ids`` must be exactly ceil(len(prompt)/block_size) pages
+    (allocator output). Returns (last-token logits (V,) fp32, updated
+    pools). Compiles once per page count, not per prompt length.
+    """
+    block_size = int(pools["k_pool"].shape[2])
+    p = len(prompt_ids)
+    if p == 0:
+        raise ValueError("empty prompt")
+    n_pages = required_blocks(p, block_size)
+    if n_pages != len(block_ids):
+        raise ValueError(
+            f"prompt of {p} tokens needs exactly {n_pages} pages; got "
+            f"{len(block_ids)} block ids"
+        )
+    p_bucket = n_pages * block_size
+    prompt = jnp.zeros((1, p_bucket), jnp.int32)
+    prompt = prompt.at[0, :p].set(jnp.asarray(prompt_ids, jnp.int32))
+    last, dense = _prefill_dense(params, prompt, jnp.int32(p), cfg, p_bucket)
+    pools = _scatter_pages(
+        pools, dense, jnp.asarray(block_ids, jnp.int32), n_pages
+    )
+    return last, pools
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "min_p"),
+    donate_argnums=(1,),
+)
+def paged_decode_step(
+    params: Any,
+    pools: transformer.KVCache,
+    tokens: jax.Array,  # (B,) int32 — each row's previously sampled token
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    seq_lens: jax.Array,  # (B,) int32
+    key: jax.Array,
+    cfg: ModelConfig,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """One lockstep decode step for every batch row (active or idle).
+
+    Writes each row's token at its slot seq_len, attends over its blocks,
+    samples the next token. Idle rows (table row all zeros, seq_len 0)
+    scribble on the reserved scratch block and their sampled token is
+    ignored by the engine. Donated pools: in-place scatter, no copy.
+    """
+    logits, pools = transformer.forward(
+        params,
+        tokens[:, None],
+        cfg,
+        kv_cache=pools,
+        paged=PagedInfo(block_tables, seq_lens),
+    )
+    nxt = sample_logits(
+        logits[:, 0], key, temperature=temperature, top_k=top_k,
+        top_p=top_p, min_p=min_p,
+    )
+    return nxt.astype(jnp.int32), pools

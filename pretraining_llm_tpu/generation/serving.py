@@ -1,0 +1,314 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Offline generation (`generation.generate`) compiles one program per
+(batch, bucket) and every row enters and leaves together. A serving
+workload is the opposite: requests arrive whenever, finish whenever, and
+the device must never idle waiting for the longest row. This engine keeps
+ONE compiled lockstep decode program (`paged.paged_decode_step`, shape
+(max_batch, max_blocks) fixed at construction) and mutates only host-side
+int32 state between steps:
+
+  admission   — a waiting request claims a free batch row + pool blocks,
+                prefills its prompt into its pages, joins the next step;
+  growth      — a row crossing a block boundary gets one more block;
+  eviction    — a finished row frees its blocks and the row slot;
+  preemption  — when the pool runs dry, the youngest running request is
+                evicted and requeued (recompute-on-resume: its prompt +
+                generated-so-far become the new prompt), so the oldest
+                requests always run to completion — no deadlock.
+
+TPU-first shape discipline: idle rows keep decoding into the reserved
+scratch block (block 0) with their outputs ignored — a masked no-op is
+cheaper than a recompile, and XLA sees a static (max_batch,) program
+forever. The reference has no serving stack (batch-1 fixed-count
+generate, /root/reference/src/models/transformer.py:96-114).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.generation import paged
+from pretraining_llm_tpu.generation.sampling import sample_logits
+from pretraining_llm_tpu.models import transformer
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new: int
+    generated: List[int] = dataclasses.field(default_factory=list)
+    # Tokens generated in earlier incarnations of a preempted request:
+    # they were folded into `prompt` for recompute-on-resume, but they
+    # belong to the OUTPUT (see _preempt/_finish).
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    row: Optional[int] = None
+    admit_order: int = -1  # monotonically increasing per admission
+    preemptions: int = 0
+
+
+class ServingEngine:
+    """Continuous-batching text generation over a shared paged KV pool.
+
+    Usage::
+
+        eng = ServingEngine(params, cfg, max_batch=4, n_blocks=128)
+        rid = eng.submit(prompt_ids, max_new_tokens=64)
+        outputs = eng.run()        # {rid: [token, ...]}
+
+    ``temperature=0`` (default) decodes greedily; sampling parameters are
+    engine-global (per-request values would either recompile or pay a
+    (B,)-vector mask per knob — the global default matches the common
+    single-model deployment).
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: ModelConfig,
+        *,
+        max_batch: int = 8,
+        n_blocks: int = 256,
+        block_size: int = 64,
+        max_seq: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        stop_token: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if cfg.n_experts:
+            # Same restriction as ragged generate: pad slots inside a
+            # prefill bucket would compete for expert capacity.
+            raise ValueError("paged serving does not support MoE models yet")
+        if cfg.doc_mask_token >= 0:
+            # Decode sessions are single documents; forward() rejects the
+            # combination with a cache (same sanitization as generate()).
+            cfg = dataclasses.replace(cfg, doc_mask_token=-1)
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.max_seq = int(min(max_seq or cfg.context_length, cfg.context_length))
+        # Table width: no row can ever hold more than the pool's usable
+        # blocks, so clamping cuts the per-step gather/score width for
+        # small pools (the attention kv_len is max_blocks * block_size).
+        self.max_blocks = min(
+            paged.required_blocks(self.max_seq, self.block_size), n_blocks - 1
+        )
+        self.temperature = temperature
+        self.top_k, self.top_p, self.min_p = top_k, top_p, min_p
+        self.stop_token = stop_token
+
+        self.pools = transformer.make_paged_kv_pool(cfg, n_blocks, block_size)
+        self.alloc = paged.BlockAllocator(n_blocks)
+        self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
+        self.seq_lens = np.zeros((self.max_batch,), np.int32)
+        self.tokens = np.zeros((self.max_batch,), np.int32)
+        self.rows: List[Optional[_Request]] = [None] * self.max_batch
+        self.waiting: deque = deque()
+        self.finished: Dict[int, List[int]] = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._next_rid = 0
+        self._admit_counter = 0
+        self.stats = {"steps": 0, "tokens": 0, "preemptions": 0, "admissions": 0}
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int) -> int:
+        """Queue a request; returns its id. Fails fast if the request can
+        never fit (prompt + generation must fit max_seq AND the pool)."""
+        p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = p + max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt({p}) + max_new({max_new_tokens}) = {total} exceeds "
+                f"max_seq={self.max_seq}"
+            )
+        if paged.required_blocks(total, self.block_size) > self.alloc.n_blocks - 1:
+            raise ValueError(
+                f"request needs {paged.required_blocks(total, self.block_size)} "
+                f"blocks; the pool only has {self.alloc.n_blocks - 1}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.waiting.append(_Request(rid, list(prompt_ids), int(max_new_tokens)))
+        return rid
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self.rows)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.n_active > 0
+
+    def step(self) -> None:
+        """One scheduling round: admit -> grow/preempt -> lockstep decode
+        -> reap. A no-op when nothing is running or waiting."""
+        self._admit()
+        if self.n_active == 0:
+            return
+        self._ensure_write_pages()
+        if self.n_active == 0:  # everyone got preempted (tiny pool)
+            return
+        # Backstop for the PagedInfo capacity invariant (submit() bounds
+        # every request structurally; this keeps scheduler bugs loud).
+        paged.check_paged_bounds(self.tables, self.seq_lens, self.block_size)
+        self._key, sub = jax.random.split(self._key)
+        nxt, self.pools = paged.paged_decode_step(
+            self.params,
+            self.pools,
+            jnp.asarray(self.tokens),
+            jnp.asarray(self.tables),
+            jnp.asarray(self.seq_lens),
+            sub,
+            self.cfg,
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+            min_p=self.min_p,
+        )
+        nxt = np.asarray(nxt)
+        self.stats["steps"] += 1
+        for row, req in enumerate(self.rows):
+            if req is None:
+                continue
+            self.seq_lens[row] += 1  # this step wrote the pending token
+            tok = int(nxt[row])
+            req.generated.append(tok)
+            self.tokens[row] = tok
+            self.stats["tokens"] += 1
+            if tok == self.stop_token or len(req.generated) >= req.max_new:
+                self._finish(req)
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive step() until every submitted request has finished."""
+        while self.has_work():
+            self.step()
+        return self.finished
+
+    # -- scheduling internals ---------------------------------------------
+
+    def _admit(self) -> None:
+        """FCFS admission: the head of the queue claims a free row when the
+        pool covers its prompt pages + the first decode write."""
+        while self.waiting:
+            free_rows = [i for i, r in enumerate(self.rows) if r is None]
+            if not free_rows:
+                return
+            req: _Request = self.waiting[0]
+            p = len(req.prompt)
+            # +1: the first decode step writes slot p — its page must exist.
+            need = paged.required_blocks(p + 1, self.block_size)
+            # Admission watermark: keep one growth block of headroom per
+            # already-running row, else a nearly-dry pool admits + pays a
+            # full prefill only for the newcomer to be preempted at the
+            # next older-row block boundary (prefill thrash).
+            if self.alloc.available - need < self.n_active:
+                return
+            blocks = self.alloc.alloc(need)
+            if blocks is None:
+                return  # head-of-line blocks; preemption happens on growth
+            self.waiting.popleft()
+            row = free_rows[0]
+            prefill_pages = paged.required_blocks(p, self.block_size)
+            last, self.pools = paged.prefill_into_pool(
+                self.params, self.cfg, self.pools, req.prompt,
+                blocks[:prefill_pages],
+            )
+            self._key, sub = jax.random.split(self._key)
+            tok = int(
+                sample_logits(
+                    last[None], sub, temperature=self.temperature,
+                    top_k=self.top_k, top_p=self.top_p, min_p=self.min_p,
+                )[0]
+            )
+            req.blocks = blocks
+            req.row = row
+            req.admit_order = self._admit_counter
+            self._admit_counter += 1
+            self.stats["admissions"] += 1
+            req.generated.append(tok)
+            self.rows[row] = req
+            self.tables[row, :] = 0
+            self.tables[row, : len(blocks)] = blocks
+            self.seq_lens[row] = p
+            self.tokens[row] = tok
+            if tok == self.stop_token or len(req.generated) >= req.max_new:
+                self._finish(req)
+
+    def _ensure_write_pages(self) -> None:
+        """Every active row's next write slot must have an allocated page;
+        when the pool is dry, preempt youngest-first (recompute-on-resume)
+        so the oldest admitted requests always make progress."""
+        for row in range(self.max_batch):
+            req = self.rows[row]
+            if req is None:
+                continue
+            while True:
+                page = int(self.seq_lens[row]) // self.block_size
+                if page < len(req.blocks):
+                    break
+                got = self.alloc.alloc(1)
+                if got is not None:
+                    req.blocks.extend(got)
+                    self.tables[row, len(req.blocks) - 1] = got[0]
+                    continue
+                victim = max(
+                    (r for r in self.rows if r is not None),
+                    key=lambda r: r.admit_order,
+                )
+                self._preempt(victim)
+                if victim is req:
+                    break  # this row is gone; nothing more to grow
+
+    def _preempt(self, req: _Request) -> None:
+        """Evict a running request: free its memory, requeue it at the
+        FRONT with prompt+generated as the new prompt (vLLM-style recompute
+        recovery — cheap for short generations, and the only option that
+        frees ALL its blocks)."""
+        row = req.row
+        assert row is not None
+        self.stats["preemptions"] += 1
+        new_prompt = req.prompt + req.generated
+        remaining = req.max_new - len(req.generated)
+        assert remaining >= 1, "finished requests are reaped, not preempted"
+        self._release_row(req)
+        fresh = _Request(
+            req.rid, new_prompt, remaining,
+            prefix=req.prefix + req.generated,
+            preemptions=req.preemptions + 1,
+        )
+        self.waiting.appendleft(fresh)
+
+    def _finish(self, req: _Request) -> None:
+        out = req.prefix + req.generated
+        if self.stop_token is not None and out and out[-1] == self.stop_token:
+            out = out[:-1]
+        self.finished[req.rid] = out
+        self._release_row(req)
+
+    def _release_row(self, req: _Request) -> None:
+        row = req.row
+        assert row is not None
+        self.alloc.free(req.blocks)
+        req.blocks = []
+        req.row = None
+        self.rows[row] = None
+        self.tables[row, :] = 0
+        self.seq_lens[row] = 0
+        self.tokens[row] = 0

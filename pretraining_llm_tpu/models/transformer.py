@@ -26,7 +26,7 @@ the blocks.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,29 @@ from pretraining_llm_tpu.parallel.sharding import constrain, current_mesh
 
 Params = Dict[str, Any]
 KVCache = Dict[str, jax.Array]  # {'k','v'}: (L, B, Tmax, kv_heads, Dh)
+
+
+class PagedInfo(NamedTuple):
+    """Batch-level paged-decode state, shared by every layer.
+
+    The per-layer block POOLS ride the kv_cache scan carry exactly like the
+    contiguous cache (see make_paged_kv_pool); the int32 routing state here
+    is what the serving engine mutates host-side between steps — admission,
+    growth, and eviction never change a device-array shape, so the decode
+    program compiles once and serves forever (vLLM's PagedAttention idea
+    re-expressed for XLA's static-shape model: block tables are gather/
+    scatter indices, not pointers).
+
+    INVARIANT (caller-enforced, unchecked under jit): every row's
+    seq_lens < max_blocks * block_size — a decode step WRITES slot
+    seq_lens, so at capacity the page index would clamp onto the row's
+    last table entry and silently overwrite a live block. Schedulers must
+    bound-check host-side before dispatch (ServingEngine does; drive
+    `generation.paged.check_paged_bounds` if you build tables yourself).
+    """
+
+    block_tables: jax.Array  # (B, max_blocks) int32 — pool block ids per row
+    seq_lens: jax.Array  # (B,) int32 — tokens already in the cache per row
 
 
 def _lm_head_weights(params: Params, cfg: ModelConfig):
@@ -151,6 +174,7 @@ def _attention_block(
     zigzag: bool = False,
     pad_offsets: Optional[jax.Array] = None,
     segments: Optional[jax.Array] = None,
+    paged: Optional[PagedInfo] = None,
 ) -> Tuple[jax.Array, Optional[Params]]:
     """Pre-LN attention sub-block: x + attn(ln1(x)). Returns (x, new_kv).
 
@@ -188,7 +212,11 @@ def _attention_block(
 
     if rope is not None:
         cos, sin = rope
-        if pad_offsets is not None:
+        if paged is not None:
+            # Paged decode: row i's query IS the token at logical position
+            # seq_lens[i] (linear index within its own block list).
+            rope_pos = paged.seq_lens[:, None]
+        elif pad_offsets is not None:
             # Per-row logical positions: slot - left-pad offset. Pad slots
             # clip to 0; their K/V is masked out of every real attention.
             rope_pos = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
@@ -217,7 +245,84 @@ def _attention_block(
         return jnp.repeat(a, n_rep, axis=2) if n_rep > 1 else a
 
     new_kv: Optional[Params] = None
-    if kv is not None:
+    if kv is not None and "k_pool" in kv:
+        # PAGED decode (serving): the cache is a POOL of fixed-size blocks
+        # (n_blocks, block_size, G, Dh); each batch row owns an ordered list
+        # of pool block ids (paged.block_tables) and a logical length
+        # (paged.seq_lens). One step = scatter this token's K/V into the
+        # row's slot seq_len, then attend over the row's gathered blocks
+        # masked to <= seq_len. All shapes are static — the serving engine
+        # admits/evicts requests by editing int32 tables host-side, never
+        # recompiling. (The reference has no serving path at all; its
+        # generate is batch-1 fixed-count, transformer.py:96-114.)
+        if paged is None:
+            raise ValueError(
+                "a paged kv pool requires forward(..., paged=PagedInfo)"
+            )
+        if k.shape[1] != 1:
+            raise ValueError(
+                "the in-forward paged path is single-token decode only; "
+                "prompts enter the pool via generation.paged.prefill_into_pool"
+            )
+        bsz = q.shape[0]
+        block_size = kv["k_pool"].shape[1]
+        tables, seq = paged.block_tables, paged.seq_lens
+        blk_ids = tables[jnp.arange(bsz), seq // block_size]  # (B,)
+        slots = seq % block_size  # (B,)
+        quantized = "k_scale_pool" in kv
+
+        def scatter(pool, val):
+            # One (B,)-row scatter per pool: rows own disjoint blocks, so
+            # indices collide only between idle rows parked on the reserved
+            # scratch block — whose content is never unmasked.
+            return pool.at[blk_ids, slots].set(val.astype(pool.dtype))
+
+        if quantized:
+            k_q, k_sc = _kv_quantize(k[:, 0])
+            v_q, v_sc = _kv_quantize(v[:, 0])
+            new_kv = {
+                "k_pool": scatter(kv["k_pool"], k_q),
+                "v_pool": scatter(kv["v_pool"], v_q),
+                "k_scale_pool": scatter(kv["k_scale_pool"], k_sc),
+                "v_scale_pool": scatter(kv["v_scale_pool"], v_sc),
+            }
+        else:
+            new_kv = {
+                "k_pool": scatter(kv["k_pool"], k[:, 0]),
+                "v_pool": scatter(kv["v_pool"], v[:, 0]),
+            }
+
+        max_blocks = tables.shape[1]
+        kv_len = max_blocks * block_size
+
+        def gather(pool):
+            # (B, max_blocks, block_size, ...) -> (B, kv_len, ...): each
+            # row's logical KV sequence, assembled from its pool blocks.
+            return pool[tables].reshape((bsz, kv_len) + pool.shape[2:])
+
+        if quantized:
+            ck = _kv_dequantize(
+                gather(new_kv["k_pool"]), gather(new_kv["k_scale_pool"]), cdt
+            )
+            cv = _kv_dequantize(
+                gather(new_kv["v_pool"]), gather(new_kv["v_scale_pool"]), cdt
+            )
+        else:
+            ck = gather(new_kv["k_pool"]).astype(cdt)
+            cv = gather(new_kv["v_pool"]).astype(cdt)
+        lin = jnp.arange(kv_len)
+        # Causality is the length mask: slot seq (this token) and everything
+        # before it. Unallocated table tail entries point at arbitrary
+        # blocks but sit at linear indices > seq — always masked.
+        kv_mask = lin[None, :] <= seq[:, None]
+        if cfg.sliding_window:
+            kv_mask = kv_mask & (
+                lin[None, :] > seq[:, None] - cfg.sliding_window
+            )
+        out = multihead_attention(
+            q, ck, cv, impl="naive", causal=False, kv_mask=kv_mask
+        )
+    elif kv is not None:
         # Decode: write this step's K/V into the cache at cache_index, attend
         # over the whole (masked) cache. The cache is a per-layer dict
         # {'k','v'} (+ {'k_scale','v_scale'} when kv_cache_dtype='int8').
@@ -421,10 +526,11 @@ def _block(
     zigzag: bool = False,
     pad_offsets: Optional[jax.Array] = None,
     segments: Optional[jax.Array] = None,
+    paged: Optional[PagedInfo] = None,
 ) -> Tuple[jax.Array, Optional[Params], jax.Array]:
     x, new_kv = _attention_block(
         blk, x, cfg, rope, positions, kv, cache_index, zigzag, pad_offsets,
-        segments=segments,
+        segments=segments, paged=paged,
     )
     x = constrain(
         x, ("data", "fsdp"), "seq" if cfg.sequence_parallel else None, None
@@ -458,8 +564,14 @@ def forward(
     zigzag: bool = False,
     blocks_baked: bool = False,
     pad_offsets: Optional[jax.Array] = None,
+    paged: Optional[PagedInfo] = None,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Compute logits. tokens: (B, T) int32 -> logits (B, T, V) fp32.
+
+    ``paged`` + a pool-layout ``kv_cache`` (make_paged_kv_pool) selects
+    PAGED single-token decode for continuous-batching serving: block
+    tables route each row's reads/writes through a shared block pool (see
+    PagedInfo / generation.serving.ServingEngine).
 
     Training/eval: kv_cache=None. Decode: pass a stacked cache
     {'k','v'}: (L, B, Tmax, kv_heads, Dh) — plus {'k_scale','v_scale'}
@@ -507,6 +619,26 @@ def forward(
             "pad_offsets (ragged left-padded rows) is a cached-decode "
             "layout; training/eval calls must not pass it"
         )
+    if paged is not None:
+        if kv_cache is None or "k_pool" not in kv_cache:
+            raise ValueError(
+                "paged=PagedInfo requires a pool-layout kv_cache "
+                "(make_paged_kv_pool)"
+            )
+        if t != 1:
+            raise ValueError(
+                "paged decode is single-token; prompts enter the pool via "
+                "generation.paged.prefill_into_pool"
+            )
+        if pad_offsets is not None:
+            raise ValueError(
+                "pad_offsets is the contiguous ragged layout; paged rows "
+                "are ragged natively via seq_lens"
+            )
+    elif kv_cache is not None and "k_pool" in kv_cache:
+        raise ValueError(
+            "a pool-layout kv_cache requires forward(..., paged=PagedInfo)"
+        )
     if positions is None:
         start = cache_index if cache_index is not None else 0
         positions = start + jnp.arange(t)
@@ -539,7 +671,10 @@ def forward(
     x = emb_table[tokens].astype(cdt)
     if cfg.pos_embed == "learned":
         pos_table = constrain(params["pos_embed"]["embedding"], None, None)
-        if pad_offsets is not None:
+        if paged is not None:
+            # Each row's single query sits at its own logical position.
+            x = x + pos_table[paged.seq_lens][:, None].astype(cdt)
+        elif pad_offsets is not None:
             logical = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
             x = x + pos_table[logical].astype(cdt)  # (B, T, D) per-row gather
         else:
@@ -561,7 +696,7 @@ def forward(
         blk, cache_layer = layer_inputs
         x, new_kv, aux = _block(
             blk, x, cfg, rope, positions, cache_layer, cache_index,
-            pad_offsets=pad_offsets,
+            pad_offsets=pad_offsets, paged=paged,
         )
         return (x, aux_sum + aux), new_kv
 
@@ -1019,6 +1154,44 @@ def make_kv_cache(
         }
     dtype = jnp.dtype(dtype or cfg.compute_dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def make_paged_kv_pool(
+    cfg: ModelConfig, n_blocks: int, block_size: int, dtype: Any = None
+) -> KVCache:
+    """Block POOL layout for paged serving decode (see PagedInfo).
+
+    Pools are stacked over layers like the contiguous cache and ride the
+    same depth-scan carry: {'k_pool','v_pool'}: (L, n_blocks, block_size,
+    kv_heads, Dh), plus fp32 scale pools when ``kv_cache_dtype='int8'``.
+    Block 0 is reserved by convention as the idle-row scratch target (the
+    serving engine parks inactive batch rows on it); allocators hand out
+    ids from 1.
+    """
+    if n_blocks < 2:
+        raise ValueError("need n_blocks >= 2 (block 0 is the idle scratch)")
+    if block_size % 8:
+        # TPU sublane granularity; also keeps page gathers tile-aligned.
+        raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        if dtype is not None:
+            raise ValueError(
+                f"make_paged_kv_pool(dtype={dtype!r}) conflicts with "
+                "kv_cache_dtype='int8'"
+            )
+        sshape = shape[:-1] + (1,)
+        return {
+            "k_pool": jnp.zeros(shape, jnp.int8),
+            "v_pool": jnp.zeros(shape, jnp.int8),
+            "k_scale_pool": jnp.zeros(sshape, jnp.float32),
+            "v_scale_pool": jnp.zeros(sshape, jnp.float32),
+        }
+    dtype = jnp.dtype(dtype or cfg.compute_dtype)
+    return {
+        "k_pool": jnp.zeros(shape, dtype),
+        "v_pool": jnp.zeros(shape, dtype),
+    }
 
 
 def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:

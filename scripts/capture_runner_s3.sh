@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 session-3 capture runner: chained tpu_capture invocations in
+# VERDICT-r3 priority order (profile-first after the driver race; risky
+# tier unlocks because all three criticals are already banked in the
+# campaign log). Each group polls for backend recovery (90 min pool per
+# invocation) so a wedge mid-sequence degrades to continuous polling
+# instead of a dead campaign.
+cd /root/repo || exit 1
+OUT=data/captures/tpu_capture_r04.jsonl
+for spec in \
+  "mfu|--mfu-budget 1500" \
+  "batch-sweep|" \
+  "profile,profile-decode|" \
+  "mfu-350m,mfu-1b|" \
+  "sweep2|" \
+  "decode,decode-int8,decode-unroll|" \
+  "trainer|" \
+  "unroll-sweep,sweep-top,ctx8k|" \
+; do
+  stages="${spec%%|*}"; extra="${spec#*|}"
+  echo "[runner $(date -u +%H:%M:%S)] starting stages=$stages"
+  # shellcheck disable=SC2086
+  python scripts/tpu_capture.py --stages "$stages" --out "$OUT" \
+    --recovery-wait 5400 $extra
+  rc=$?  # capture BEFORE the echo's $(date) resets $?
+  echo "[runner $(date -u +%H:%M:%S)] stages=$stages rc=$rc"
+done
+echo "[runner $(date -u +%H:%M:%S)] all stage groups done"
