@@ -632,7 +632,10 @@ _register(
 _register(
     "gpt2-350m-dp",
     Config(
-        model=_gpt2_model(context_length=1024, d_model=1024, n_heads=16, n_layers=24),
+        model=_gpt2_model(
+            context_length=1024, d_model=1024, n_heads=16, n_layers=24,
+            attention_impl="flash",
+        ),
         mesh=MeshConfig(data=-1),
         train=TrainConfig(batch_size=32, lr=3e-4),
     ),
@@ -643,7 +646,8 @@ _register(
     "gpt2-1p3b-fsdp",
     Config(
         model=_gpt2_model(
-            context_length=1024, d_model=2048, n_heads=16, n_layers=24, remat="dots_saveable"
+            context_length=1024, d_model=2048, n_heads=16, n_layers=24,
+            remat="dots_saveable", attention_impl="flash",
         ),
         mesh=MeshConfig(data=-1, fsdp=8),
         train=TrainConfig(batch_size=64, lr=2e-4, microbatches=2),
@@ -662,6 +666,7 @@ _register(
             n_layers=22,
             mlp_ratio=2.6875,  # d_ff = 5504, Llama-style 8/3 rounding
             remat="dots_saveable",
+            attention_impl="flash",
         ),
         mesh=MeshConfig(data=-1, fsdp=4),
         train=TrainConfig(batch_size=32, lr=3e-4, weight_decay=0.1),

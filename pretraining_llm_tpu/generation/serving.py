@@ -99,7 +99,14 @@ class ServingEngine:
         self.cfg = cfg
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
-        self.max_seq = int(min(max_seq or cfg.context_length, cfg.context_length))
+        # Clamp max_seq so EVERY reachable prefill bucket fits the model
+        # context: prefill pads prompts up to whole blocks, and a preempted
+        # request can be readmitted with prompt+generated as its new prompt
+        # — any p <= floor(ctx/bs)*bs then buckets within ctx, so
+        # make_kv_cache can never blow up mid-serving on an accepted
+        # request (block sizes that don't divide ctx are the trap).
+        ctx_aligned = (cfg.context_length // self.block_size) * self.block_size
+        self.max_seq = int(min(max_seq or cfg.context_length, ctx_aligned))
         # Table width: no row can ever hold more than the pool's usable
         # blocks, so clamping cuts the per-step gather/score width for
         # small pools (the attention kv_len is max_blocks * block_size).
@@ -214,15 +221,16 @@ class ServingEngine:
             p = len(req.prompt)
             # +1: the first decode step writes slot p — its page must exist.
             need = paged.required_blocks(p + 1, self.block_size)
-            # Admission watermark: keep one growth block of headroom per
-            # already-running row, else a nearly-dry pool admits + pays a
-            # full prefill only for the newcomer to be preempted at the
-            # next older-row block boundary (prefill thrash).
+            # Admission watermark — where head-of-line admission stalls:
+            # keep one growth block of headroom per already-running row,
+            # else a nearly-dry pool admits + pays a full prefill only for
+            # the newcomer to be preempted at the next older-row block
+            # boundary (prefill thrash). The stalled head waits for active
+            # rows to finish and free blocks; preemption happens on growth.
             if self.alloc.available - need < self.n_active:
                 return
             blocks = self.alloc.alloc(need)
-            if blocks is None:
-                return  # head-of-line blocks; preemption happens on growth
+            assert blocks is not None, "watermark guarantees coverage"
             self.waiting.popleft()
             row = free_rows[0]
             prefill_pages = paged.required_blocks(p, self.block_size)

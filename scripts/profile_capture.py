@@ -148,11 +148,40 @@ def main() -> None:
     if isinstance(data, bytes):
         data = data.decode("utf-8", "replace")
     rows = _extract_rows(data, args.tool)
+
+    # Persist the FULL table and end stdout with one JSON summary line:
+    # campaign stages keep only the last stdout line (tpu_capture.run_cmd),
+    # and round 4's first-ever banked profile record was one truncated
+    # HTML fragment — the whole table must live on disk, not in a pipe.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    table_dir = os.path.join(repo, "data", "captures")
+    os.makedirs(table_dir, exist_ok=True)
+    import time
+
+    # Timestamped: successive captures must not overwrite the table a
+    # previously-banked campaign record's table_path points at.
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    table_path = os.path.join(
+        table_dir, f"profile_{args.mode}_{args.tool}_{stamp}.tsv"
+    )
+    with open(table_path, "w") as f:
+        f.write(data if rows is None else "\n".join(rows))
     if rows is None:
-        print(data[:8000])
+        print(json.dumps({"table_path": table_path, "parsed": False}))
         return
     for r in rows[: args.top]:
         print(r)
+    import re
+
+    def clean(row: str) -> str:
+        return re.sub(r"<[^>]+>", "", row)[:240]
+
+    print(json.dumps({
+        "table_path": table_path,
+        "n_rows": len(rows),
+        "header": clean(rows[0]) if rows else "",
+        "top": [clean(r) for r in rows[1: min(9, len(rows))]],
+    }))
 
 
 def _extract_rows(data: str, tool: str):

@@ -203,6 +203,22 @@ def test_engine_rejects_oversized(params):
         eng.submit([], 4)
 
 
+def test_engine_block_size_not_dividing_context(params):
+    """block_size that doesn't divide context_length: max_seq clamps to
+    the aligned floor, so a near-context prompt is rejected at submit()
+    instead of crashing prefill mid-serving (prefill pads to whole
+    blocks, which would overflow the position tables)."""
+    # tiny ctx=64; block_size=24 -> aligned max_seq=48
+    eng = ServingEngine(params, CFG, max_batch=1, n_blocks=8, block_size=24)
+    assert eng.max_seq == 48
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit(list(range(45)), 10)  # fits ctx=64 but not aligned 48
+    p = _prompts(1, lengths=(14,))[0]
+    rid = eng.submit(p, 8)
+    out = eng.run()
+    assert out[rid] == _reference_greedy(params, CFG, p, 8)
+
+
 def test_engine_interleaved_submission(params):
     """Requests submitted WHILE others are decoding (the continuous part
     of continuous batching): mid-flight admission must not perturb
