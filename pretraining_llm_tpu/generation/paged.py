@@ -190,6 +190,27 @@ def prefill_into_pool(
     return last, pools
 
 
+def _forward_sample_one(
+    params, pools, tokens, block_tables, seq_lens, key, cfg,
+    temperature, top_k, top_p, min_p,
+):
+    """The single decode step both jitted entry points trace: forward one
+    token per row through the paged cache, sample the next. Kept as ONE
+    definition so the sps=1 and windowed paths can never diverge."""
+    logits, pools = transformer.forward(
+        params,
+        tokens[:, None],
+        cfg,
+        kv_cache=pools,
+        paged=PagedInfo(block_tables, seq_lens),
+    )
+    nxt = sample_logits(
+        logits[:, 0], key, temperature=temperature, top_k=top_k,
+        top_p=top_p, min_p=min_p,
+    )
+    return nxt.astype(jnp.int32), pools
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "temperature", "top_k", "top_p", "min_p"),
@@ -214,16 +235,62 @@ def paged_decode_step(
     samples the next token. Idle rows (table row all zeros, seq_len 0)
     scribble on the reserved scratch block and their sampled token is
     ignored by the engine. Donated pools: in-place scatter, no copy.
+    (Kept as its own jit rather than paged_decode_steps(n=1): the raw
+    ``key`` preserves the existing sps=1 sampling stream, where the scan
+    would consume split(key, 1)[0].)
     """
-    logits, pools = transformer.forward(
-        params,
-        tokens[:, None],
-        cfg,
-        kv_cache=pools,
-        paged=PagedInfo(block_tables, seq_lens),
+    return _forward_sample_one(
+        params, pools, tokens, block_tables, seq_lens, key, cfg,
+        temperature, top_k, top_p, min_p,
     )
-    nxt = sample_logits(
-        logits[:, 0], key, temperature=temperature, top_k=top_k,
-        top_p=top_p, min_p=min_p,
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
+                     "min_p"),
+    donate_argnums=(1,),
+)
+def paged_decode_steps(
+    params: Any,
+    pools: transformer.KVCache,
+    tokens: jax.Array,  # (B,) int32
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    seq_lens: jax.Array,  # (B,) int32
+    key: jax.Array,
+    cfg: ModelConfig,
+    n_steps: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """``n_steps`` lockstep decode steps in ONE device program.
+
+    Multi-step scheduling: per-step host dispatch dominates a serving
+    engine on a high-latency link (the tunneled backend pays ~ms per
+    call), so the scheduler runs a fixed window of steps per dispatch and
+    reaps/admits only at window boundaries. Rows that finish mid-window
+    keep decoding into their own (pre-allocated, then freed) pages and
+    the host discards the surplus tokens; rows that pass their table
+    capacity redirect writes to the scratch block (see the overshoot
+    guard in the model's paged branch). The scheduler must pre-allocate
+    pages covering seq_len + n_steps writes per surviving row
+    (ServingEngine._ensure_write_pages horizon).
+
+    Returns ((B, n_steps) sampled tokens in order, updated pools).
+    """
+
+    def one(carry, sub):
+        pools, tok, seq = carry
+        nxt, pools = _forward_sample_one(
+            params, pools, tok, block_tables, seq, sub, cfg,
+            temperature, top_k, top_p, min_p,
+        )
+        return (pools, nxt, seq + 1), nxt
+
+    subs = jax.random.split(key, n_steps)
+    (pools, _, _), toks = jax.lax.scan(
+        one, (pools, tokens, seq_lens), subs
     )
-    return nxt.astype(jnp.int32), pools
+    return toks.T, pools  # (B, n_steps)

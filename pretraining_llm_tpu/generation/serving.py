@@ -86,6 +86,7 @@ class ServingEngine:
         min_p: Optional[float] = None,
         stop_token: Optional[int] = None,
         seed: int = 0,
+        steps_per_sched: int = 1,
     ):
         if cfg.n_experts:
             # Same restriction as ragged generate: pad slots inside a
@@ -116,6 +117,12 @@ class ServingEngine:
         self.temperature = temperature
         self.top_k, self.top_p, self.min_p = top_k, top_p, min_p
         self.stop_token = stop_token
+        # Multi-step scheduling: decode windows of K steps per device
+        # dispatch (one compiled scan), reaping/admitting only at window
+        # boundaries — the lever against per-step host dispatch latency
+        # on the tunneled backend. Rows finishing mid-window overrun into
+        # their own pages (surplus discarded host-side).
+        self.steps_per_sched = max(1, int(steps_per_sched))
 
         self.pools = transformer.make_paged_kv_pool(cfg, n_blocks, block_size)
         self.alloc = paged.BlockAllocator(n_blocks)
@@ -164,43 +171,51 @@ class ServingEngine:
         return bool(self.waiting) or self.n_active > 0
 
     def step(self) -> None:
-        """One scheduling round: admit -> grow/preempt -> lockstep decode
-        -> reap. A no-op when nothing is running or waiting."""
+        """One scheduling round: admit -> grow/preempt -> a window of
+        ``steps_per_sched`` lockstep decode steps -> reap. A no-op when
+        nothing is running or waiting."""
         self._admit()
         if self.n_active == 0:
             return
-        self._ensure_write_pages()
+        n = self.steps_per_sched
+        self._ensure_write_pages(horizon=n)
         if self.n_active == 0:  # everyone got preempted (tiny pool)
             return
         # Backstop for the PagedInfo capacity invariant (submit() bounds
         # every request structurally; this keeps scheduler bugs loud).
+        # Multi-step windows may overshoot capacity mid-window — that is
+        # handled by the model's scratch-redirect guard; the invariant
+        # here is on the WINDOW-START state only.
         paged.check_paged_bounds(self.tables, self.seq_lens, self.block_size)
         self._key, sub = jax.random.split(self._key)
-        nxt, self.pools = paged.paged_decode_step(
-            self.params,
-            self.pools,
-            jnp.asarray(self.tokens),
-            jnp.asarray(self.tables),
-            jnp.asarray(self.seq_lens),
-            sub,
-            self.cfg,
-            temperature=self.temperature,
-            top_k=self.top_k,
-            top_p=self.top_p,
-            min_p=self.min_p,
+        common = dict(
+            cfg=self.cfg, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, min_p=self.min_p,
         )
-        nxt = np.asarray(nxt)
-        self.stats["steps"] += 1
+        dev_args = (
+            self.params, self.pools, jnp.asarray(self.tokens),
+            jnp.asarray(self.tables), jnp.asarray(self.seq_lens), sub,
+        )
+        if n == 1:
+            nxt, self.pools = paged.paged_decode_step(*dev_args, **common)
+            window = np.asarray(nxt)[:, None]  # (B, 1)
+        else:
+            toks, self.pools = paged.paged_decode_steps(
+                *dev_args, n_steps=n, **common
+            )
+            window = np.asarray(toks)  # (B, n)
+        self.stats["steps"] += n
         for row, req in enumerate(self.rows):
             if req is None:
                 continue
-            self.seq_lens[row] += 1  # this step wrote the pending token
-            tok = int(nxt[row])
-            req.generated.append(tok)
-            self.tokens[row] = tok
-            self.stats["tokens"] += 1
-            if tok == self.stop_token or len(req.generated) >= req.max_new:
-                self._finish(req)
+            for tok in (int(t) for t in window[row]):
+                self.seq_lens[row] += 1  # this step wrote the pending token
+                req.generated.append(tok)
+                self.tokens[row] = tok
+                self.stats["tokens"] += 1
+                if tok == self.stop_token or len(req.generated) >= req.max_new:
+                    self._finish(req)
+                    break  # surplus window tokens for this row are discarded
 
     def run(self) -> Dict[int, List[int]]:
         """Drive step() until every submitted request has finished."""
@@ -259,18 +274,27 @@ class ServingEngine:
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
 
-    def _ensure_write_pages(self) -> None:
-        """Every active row's next write slot must have an allocated page;
-        when the pool is dry, preempt youngest-first (recompute-on-resume)
-        so the oldest admitted requests always make progress."""
+    def _ensure_write_pages(self, horizon: int = 1) -> None:
+        """Every active row's next ``horizon`` write slots must have
+        allocated pages (writes landing in a surviving row's unallocated
+        page would silently fall through to the scratch block and LOSE
+        that token's K/V); when the pool is dry, preempt youngest-first
+        (recompute-on-resume) so the oldest admitted requests always make
+        progress. Slots a row cannot reach before finishing (remaining <
+        horizon) or that exceed table capacity don't need pages — those
+        surplus writes are scratch-redirected and discarded by design."""
+        capacity = self.max_blocks * self.block_size
         for row in range(self.max_batch):
             req = self.rows[row]
             if req is None:
                 continue
-            while True:
-                page = int(self.seq_lens[row]) // self.block_size
-                if page < len(req.blocks):
-                    break
+            remaining = req.max_new - len(req.generated)
+            last_write = min(
+                int(self.seq_lens[row]) + min(horizon, remaining) - 1,
+                capacity - 1,
+            )
+            need_pages = last_write // self.block_size + 1
+            while len(req.blocks) < need_pages:
                 got = self.alloc.alloc(1)
                 if got is not None:
                     req.blocks.extend(got)

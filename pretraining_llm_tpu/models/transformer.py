@@ -267,8 +267,19 @@ def _attention_block(
         bsz = q.shape[0]
         block_size = kv["k_pool"].shape[1]
         tables, seq = paged.block_tables, paged.seq_lens
-        blk_ids = tables[jnp.arange(bsz), seq // block_size]  # (B,)
-        slots = seq % block_size  # (B,)
+        # Multi-step scheduling overshoot guard: inside a fixed-length
+        # decode window a row can pass its capacity (it gets reaped right
+        # after); redirect such writes to the reserved scratch block
+        # instead of letting the page index clamp onto the row's LAST
+        # block and corrupt a live slot. Single-step schedulers never hit
+        # this (check_paged_bounds), multi-step ones hit it by design.
+        capacity = tables.shape[1] * block_size
+        in_range = seq < capacity
+        seq_c = jnp.minimum(seq, capacity - 1)
+        blk_ids = jnp.where(
+            in_range, tables[jnp.arange(bsz), seq_c // block_size], 0
+        )  # (B,)
+        slots = jnp.where(in_range, seq_c % block_size, 0)  # (B,)
         quantized = "k_scale_pool" in kv
 
         def scatter(pool, val):

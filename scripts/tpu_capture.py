@@ -62,7 +62,7 @@ CRITICAL_STAGES = ("mfu", "parity-tpu", "e2e")
 # may only run once every critical record is banked.
 RISKY_STAGES = frozenset(
     {"profile", "profile-decode", "decode-int8", "decode-unroll",
-     "unroll-sweep", "sweep-full"}
+     "unroll-sweep", "sweep-full", "serving"}
 )
 
 
@@ -259,7 +259,7 @@ def main() -> int:
         "ctx8k", "trainer",
         "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
         "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
-        "mfu-1b-ladder",
+        "mfu-1b-ladder", "serving",
     }
     want = None
     if args.stages:
@@ -630,6 +630,21 @@ def _run_stages(args, on, gated, risky, py) -> None:
                  "--timeout-budget", "700"],
                 820,
             )
+
+    # 9f. Continuous-batching serving throughput (paged engine, r4): pool
+    # gather/scatter decode is a program class never compiled on this
+    # backend — risky tier. sps=1 quantifies what multi-step scheduling
+    # buys against the tunnel's per-dispatch latency.
+    if on("serving"):
+        risky(
+            "serving",
+            [py, BENCH, "--skip-canary", "--mode", "serving"], 1200,
+        )
+        risky(
+            "serving-sps1",
+            [py, BENCH, "--skip-canary", "--mode", "serving",
+             "--steps-per-sched", "1"], 1200,
+        )
 
     # 9e. The rest of the grid — RISKY (open-ended combos).
     if on("sweep-full"):

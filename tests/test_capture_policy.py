@@ -123,12 +123,14 @@ def test_full_campaign_runs_criticals_first_and_defers_risky(
     assert ran[:3] == ["mfu", "parity-tpu", "e2e"]
     # The risky tier RAN because the criticals banked.
     for risky_stage in ("profile", "profile-decode", "decode-int8",
-                        "sweep-full"):
+                        "sweep-full", "serving", "serving-sps1"):
         assert risky_stage in ran, f"{risky_stage} should have run"
     # Risky stages come strictly after EVERY non-risky stage, whatever the
     # non-risky ordering is.
     def is_risky(s):
-        return s in tpu_capture.RISKY_STAGES or s.startswith("unroll")
+        return s in tpu_capture.RISKY_STAGES or s.startswith(
+            ("unroll", "serving")
+        )
 
     first_risky = min(i for i, s in enumerate(ran) if is_risky(s))
     last_nonrisky = max(i for i, s in enumerate(ran) if not is_risky(s))

@@ -203,6 +203,59 @@ def test_engine_rejects_oversized(params):
         eng.submit([], 4)
 
 
+@pytest.mark.parametrize("window", [3, 8])
+def test_engine_multistep_matches_generate(params, window):
+    """steps_per_sched>1 runs K decode steps per device dispatch; greedy
+    output must be unchanged, including rows finishing mid-window (their
+    surplus tokens are discarded) and stop tokens."""
+    prompts = _prompts(3)
+    n_new = 10  # not a multiple of either window: mid-window finishes
+    eng = ServingEngine(
+        params, CFG, max_batch=3, n_blocks=32, block_size=8,
+        temperature=0.0, steps_per_sched=window,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_engine_multistep_capacity_overshoot(params):
+    """A row whose max_new ends exactly at pool/table capacity inside a
+    multi-step window: the in-program scratch redirect must keep live
+    blocks intact (other rows' outputs unchanged)."""
+    # capacity = max_seq = 48 with block_size 24 on ctx-64 tiny.
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=8, block_size=24,
+        temperature=0.0, steps_per_sched=8,
+    )
+    # 41+7 = 48 == capacity AND max_new(7) < window(8): the row's final
+    # window step runs at seq == capacity, firing the in_range=False
+    # scratch redirect (41+8 with an 8-aligned window would stop at
+    # seq == capacity-1 and never exercise the guard).
+    p_long = _prompts(1, lengths=(41,))[0]
+    p_short = _prompts(1, lengths=(7,))[0]
+    r1 = eng.submit(p_long, 7)
+    r2 = eng.submit(p_short, 30)
+    out = eng.run()
+    assert out[r1] == _reference_greedy(params, CFG, p_long, 7)
+    assert out[r2] == _reference_greedy(params, CFG, p_short, 30)
+
+
+def test_engine_multistep_preemption(params):
+    prompts = [_prompts(1, lengths=(12,))[0], _prompts(1, lengths=(10,))[0]]
+    n_new = 24
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=8, block_size=8,
+        temperature=0.0, steps_per_sched=4,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    assert eng.stats["preemptions"] >= 1
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
 def test_engine_block_size_not_dividing_context(params):
     """block_size that doesn't divide context_length: max_seq clamps to
     the aligned floor, so a near-context prompt is rejected at submit()
