@@ -266,6 +266,7 @@ class ServingEngine:
             self._admit_counter += 1
             self.stats["admissions"] += 1
             req.generated.append(tok)
+            self.stats["tokens"] += 1  # the prefill-sampled first token
             self.rows[row] = req
             self.tables[row, :] = 0
             self.tables[row, : len(blocks)] = blocks

@@ -523,9 +523,11 @@ def _run_stages(args, on, gated, risky, py) -> None:
         # campaigns; the auto block size stands.
         for extra in (
             ["--remat", "save_attn", "--batch", "48"],
-            # The 8k preset's remat is dots_saveable (0.2475 measured);
-            # save_attn won every gpt2-124m point — try it at 8k too.
-            ["--preset", "gpt2-8k-sp", "--remat", "save_attn"],
+            # 8k comparison arms. The preset default became save_attn on
+            # 2026-08-01 (same-day measured 24.2% vs dots_saveable 23.9%),
+            # so the plain ctx8k stage now measures save_attn; these arms
+            # keep the ALTERNATIVE policies in the series.
+            ["--preset", "gpt2-8k-sp", "--remat", "dots_saveable"],
             ["--preset", "gpt2-8k-sp", "--remat", "save_big"],
         ):
             gated(
