@@ -759,6 +759,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--kv-dtype", args.kv_dtype]
     if args.decode_unroll:
         cmd.append("--decode-unroll")
+    if args.steps_per_sched:
+        cmd += ["--steps-per-sched", str(args.steps_per_sched)]
     if args.attention or attention:
         cmd += ["--attention", args.attention or attention]
     if args.ce or ce_override:

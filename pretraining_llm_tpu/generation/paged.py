@@ -130,13 +130,14 @@ def _scatter_pages(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "p_bucket"))
+@functools.partial(jax.jit, static_argnames=("cfg", "p_bucket", "mesh"))
 def _prefill_dense(
     params: Any,
     prompt: jax.Array,  # (1, p_bucket) int32, zero-padded
     prompt_len: jax.Array,  # () int32 — true length, traced
     cfg: ModelConfig,
     p_bucket: int,
+    mesh: Any = None,
 ) -> Tuple[jax.Array, transformer.KVCache]:
     """One causal forward over the padded prompt into a fresh dense cache
     sized exactly p_bucket. Returns (last real token's logits (V,), cache).
@@ -146,15 +147,18 @@ def _prefill_dense(
     decode write to slot seq_len lands BEFORE the mask exposes it, exactly
     the dense-prefill overwrite discipline (`generate._generate_jit`).
     """
-    cache = transformer.make_kv_cache(cfg, 1, p_bucket)
-    logits, cache = transformer.forward(
-        params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
-    )
-    idx = jnp.broadcast_to(
-        (prompt_len - 1).astype(jnp.int32), (1, 1, logits.shape[-1])
-    )
-    last = jnp.take_along_axis(logits, idx, axis=1)[0, 0]
-    return last, cache
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    with activation_mesh(mesh):
+        cache = transformer.make_kv_cache(cfg, 1, p_bucket)
+        logits, cache = transformer.forward(
+            params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
+        )
+        idx = jnp.broadcast_to(
+            (prompt_len - 1).astype(jnp.int32), (1, 1, logits.shape[-1])
+        )
+        last = jnp.take_along_axis(logits, idx, axis=1)[0, 0]
+        return last, cache
 
 
 def prefill_into_pool(
@@ -163,6 +167,8 @@ def prefill_into_pool(
     pools: transformer.KVCache,
     prompt_ids: Sequence[int],
     block_ids: Sequence[int],
+    *,
+    mesh: Any = None,
 ) -> Tuple[jax.Array, transformer.KVCache]:
     """Prefill one prompt and write its pages into the pool.
 
@@ -183,7 +189,9 @@ def prefill_into_pool(
     p_bucket = n_pages * block_size
     prompt = jnp.zeros((1, p_bucket), jnp.int32)
     prompt = prompt.at[0, :p].set(jnp.asarray(prompt_ids, jnp.int32))
-    last, dense = _prefill_dense(params, prompt, jnp.int32(p), cfg, p_bucket)
+    last, dense = _prefill_dense(
+        params, prompt, jnp.int32(p), cfg, p_bucket, mesh
+    )
     pools = _scatter_pages(
         pools, dense, jnp.asarray(block_ids, jnp.int32), n_pages
     )
@@ -192,28 +200,31 @@ def prefill_into_pool(
 
 def _forward_sample_one(
     params, pools, tokens, block_tables, seq_lens, key, cfg,
-    temperature, top_k, top_p, min_p,
+    temperature, top_k, top_p, min_p, mesh=None,
 ):
     """The single decode step both jitted entry points trace: forward one
     token per row through the paged cache, sample the next. Kept as ONE
     definition so the sps=1 and windowed paths can never diverge."""
-    logits, pools = transformer.forward(
-        params,
-        tokens[:, None],
-        cfg,
-        kv_cache=pools,
-        paged=PagedInfo(block_tables, seq_lens),
-    )
-    nxt = sample_logits(
-        logits[:, 0], key, temperature=temperature, top_k=top_k,
-        top_p=top_p, min_p=min_p,
-    )
-    return nxt.astype(jnp.int32), pools
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    with activation_mesh(mesh):
+        logits, pools = transformer.forward(
+            params,
+            tokens[:, None],
+            cfg,
+            kv_cache=pools,
+            paged=PagedInfo(block_tables, seq_lens),
+        )
+        nxt = sample_logits(
+            logits[:, 0], key, temperature=temperature, top_k=top_k,
+            top_p=top_p, min_p=min_p,
+        )
+        return nxt.astype(jnp.int32), pools
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p", "min_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "min_p", "mesh"),
     donate_argnums=(1,),
 )
 def paged_decode_step(
@@ -228,6 +239,7 @@ def paged_decode_step(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     min_p: Optional[float] = None,
+    mesh: Any = None,
 ) -> Tuple[jax.Array, transformer.KVCache]:
     """One lockstep decode step for every batch row (active or idle).
 
@@ -241,14 +253,14 @@ def paged_decode_step(
     """
     return _forward_sample_one(
         params, pools, tokens, block_tables, seq_lens, key, cfg,
-        temperature, top_k, top_p, min_p,
+        temperature, top_k, top_p, min_p, mesh,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
-                     "min_p"),
+                     "min_p", "mesh"),
     donate_argnums=(1,),
 )
 def paged_decode_steps(
@@ -264,6 +276,7 @@ def paged_decode_steps(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     min_p: Optional[float] = None,
+    mesh: Any = None,
 ) -> Tuple[jax.Array, transformer.KVCache]:
     """``n_steps`` lockstep decode steps in ONE device program.
 
@@ -285,7 +298,7 @@ def paged_decode_steps(
         pools, tok, seq = carry
         nxt, pools = _forward_sample_one(
             params, pools, tok, block_tables, seq, sub, cfg,
-            temperature, top_k, top_p, min_p,
+            temperature, top_k, top_p, min_p, mesh,
         )
         return (pools, nxt, seq + 1), nxt
 

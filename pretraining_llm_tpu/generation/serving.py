@@ -87,6 +87,7 @@ class ServingEngine:
         stop_token: Optional[int] = None,
         seed: int = 0,
         steps_per_sched: int = 1,
+        mesh: Any = None,
     ):
         if cfg.n_experts:
             # Same restriction as ragged generate: pad slots inside a
@@ -124,7 +125,28 @@ class ServingEngine:
         # their own pages (surplus discarded host-side).
         self.steps_per_sched = max(1, int(steps_per_sched))
 
+        # Sharded serving: params arrive pre-sharded
+        # (generate.shard_params_for_inference); the KV pools shard their
+        # kv_heads dim over the mesh's 'tensor' axis (each TP shard holds
+        # its own heads' pages — the same head split as training TP), and
+        # decode activations follow via the in-forward constraints.
+        self.mesh = mesh
         self.pools = transformer.make_paged_kv_pool(cfg, n_blocks, block_size)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp = mesh.shape.get("tensor", 1)
+            head_ax = "tensor" if (tp > 1 and cfg.kv_heads % tp == 0) else None
+            self.pools = jax.device_put(
+                self.pools,
+                {
+                    k: NamedSharding(
+                        mesh,
+                        PartitionSpec(None, None, None, head_ax, None),
+                    )
+                    for k in self.pools
+                },
+            )
         self.alloc = paged.BlockAllocator(n_blocks)
         self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
         self.seq_lens = np.zeros((self.max_batch,), np.int32)
@@ -190,7 +212,7 @@ class ServingEngine:
         self._key, sub = jax.random.split(self._key)
         common = dict(
             cfg=self.cfg, temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p, min_p=self.min_p,
+            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
         )
         dev_args = (
             self.params, self.pools, jnp.asarray(self.tokens),
@@ -251,7 +273,7 @@ class ServingEngine:
             prefill_pages = paged.required_blocks(p, self.block_size)
             last, self.pools = paged.prefill_into_pool(
                 self.params, self.cfg, self.pools, req.prompt,
-                blocks[:prefill_pages],
+                blocks[:prefill_pages], mesh=self.mesh,
             )
             self._key, sub = jax.random.split(self._key)
             tok = int(

@@ -60,8 +60,6 @@ def main() -> None:
                         help="results JSONL path (default: stdout)")
     args = parser.parse_args()
 
-    import numpy as np
-
     from pretraining_llm_tpu.data.tokenizer import get_tokenizer
     from pretraining_llm_tpu.generation.generate import (
         cast_params_for_inference, load_model_for_inference,
@@ -69,7 +67,7 @@ def main() -> None:
     from pretraining_llm_tpu.generation.serving import ServingEngine
 
     with open(args.input_file) as f:
-        texts = [ln.rstrip("\n") for ln in f if ln.strip()]
+        texts = [ln.rstrip("\r\n") for ln in f if ln.strip()]
     if not texts:
         raise SystemExit(f"no prompts in {args.input_file}")
 
@@ -86,9 +84,16 @@ def main() -> None:
         steps_per_sched=args.steps_per_sched,
     )
     rids = {}
+    rejected = []
     for i, text in enumerate(texts):
-        ids = np.asarray(enc.encode_ordinary(text), np.int32).tolist()
-        rids[eng.submit(ids, args.max_new_tokens)] = i
+        try:
+            rids[eng.submit(enc.encode_ordinary(text), args.max_new_tokens)] = i
+        except ValueError as e:
+            # One oversized prompt must not abort the other requests.
+            rejected.append(i)
+            print(f"[serve] rejected prompt {i}: {e}", file=sys.stderr)
+    if not rids:
+        raise SystemExit("every prompt was rejected")
 
     t0 = time.perf_counter()
     out = eng.run()

@@ -259,7 +259,7 @@ def main() -> int:
         "ctx8k", "trainer",
         "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
         "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
-        "mfu-1b-ladder", "serving",
+        "mfu-1b-ladder", "serving", "mfu-wave3",
     }
     want = None
     if args.stages:
@@ -511,6 +511,28 @@ def _run_stages(args, on, gated, risky, py) -> None:
                 920,
             )
 
+    # 6b''. Third-wave large-model points (2026-08-01 after the ladder):
+    # 1B full-remat rose monotonically b2 43.2 -> b4 45.1 -> b6 46.2 (b8
+    # is the next rung; clean OOM if it doesn't fit); 350M flash banked
+    # 40.2% at b32 — probe the knee upward + the save_big arm.
+    if on("mfu-wave3"):
+        for extra in (
+            ["--preset", "llama-1b", "--optimizer", "adafactor",
+             "--remat", "full", "--batch", "8"],
+            ["--preset", "gpt2-350m-dp", "--remat", "save_attn",
+             "--batch", "48"],
+            ["--preset", "gpt2-350m-dp", "--remat", "save_attn",
+             "--batch", "64"],
+            ["--preset", "gpt2-350m-dp", "--remat", "save_big",
+             "--batch", "32"],
+        ):
+            gated(
+                "mfu-wave3:" + "/".join(extra).replace("--", ""),
+                [py, BENCH, "--skip-canary", "--timeout-budget", "900"]
+                + extra,
+                1020,
+            )
+
     # 6c. Second-wave sweep: remaining unmeasured points — batch 48 (does
     # throughput keep falling past 32?) and the 8k preset under the remat
     # policies that won at 1k context.
@@ -646,6 +668,15 @@ def _run_stages(args, on, gated, risky, py) -> None:
             "serving-sps1",
             [py, BENCH, "--skip-canary", "--mode", "serving",
              "--steps-per-sched", "1"], 1200,
+        )
+        # Window-boundary host work measured ~134 ms at sps=8 (2026-08-01:
+        # 96 windows over 12.9s, in-window compute ~16 ms) — the tunnel
+        # round-trips dominate, so a larger window should multiply
+        # throughput until reap-latency waste catches up.
+        risky(
+            "serving-sps32",
+            [py, BENCH, "--skip-canary", "--mode", "serving",
+             "--steps-per-sched", "32"], 1200,
         )
 
     # 9e. The rest of the grid — RISKY (open-ended combos).

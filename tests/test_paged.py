@@ -272,6 +272,24 @@ def test_engine_block_size_not_dividing_context(params):
     assert out[rid] == _reference_greedy(params, CFG, p, 8)
 
 
+def test_engine_sharded_matches_single_device(params, mesh8):
+    """Paged serving over a dp x fsdp x tp mesh (params TP/FSDP-sharded,
+    pool kv_heads sharded over 'tensor') == unsharded serving."""
+    from pretraining_llm_tpu.generation.generate import shard_params_for_inference
+
+    prompts = _prompts(2)
+    n_new = 8
+    sharded = shard_params_for_inference(params, mesh8)
+    eng = ServingEngine(
+        sharded, CFG, max_batch=2, n_blocks=24, block_size=8,
+        temperature=0.0, steps_per_sched=4, mesh=mesh8,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
 def test_engine_interleaved_submission(params):
     """Requests submitted WHILE others are decoding (the continuous part
     of continuous batching): mid-flight admission must not perturb
