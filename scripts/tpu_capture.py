@@ -259,7 +259,7 @@ def main() -> int:
         "ctx8k", "trainer",
         "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
         "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
-        "mfu-1b-ladder", "serving", "mfu-wave3",
+        "mfu-1b-ladder", "serving", "mfu-wave3", "mfu-wave4",
     }
     want = None
     if args.stages:
@@ -515,6 +515,28 @@ def _run_stages(args, on, gated, risky, py) -> None:
     # 1B full-remat rose monotonically b2 43.2 -> b4 45.1 -> b6 46.2 (b8
     # is the next rung; clean OOM if it doesn't fit); 350M flash banked
     # 40.2% at b32 — probe the knee upward + the save_big arm.
+    # 6b'''. Fourth wave (post CE-scatter-fix, 2026-08-01): dense CE wins
+    # the 124m race after the fix (43.8 > 42.7) — probe it at the larger
+    # models; bracket the 350m knee (43.0 @ b48 > 38.6 @ b64); push the 1B
+    # batch one more rung (47.0 @ b8; OOM is clean).
+    if on("mfu-wave4"):
+        for extra in (
+            ["--preset", "llama-1b", "--optimizer", "adafactor",
+             "--remat", "full", "--batch", "8", "--ce", "dense"],
+            ["--preset", "llama-1b", "--optimizer", "adafactor",
+             "--remat", "full", "--batch", "10"],
+            ["--preset", "gpt2-350m-dp", "--remat", "save_attn",
+             "--batch", "48", "--ce", "dense"],
+            ["--preset", "gpt2-350m-dp", "--remat", "save_attn",
+             "--batch", "56"],
+        ):
+            gated(
+                "mfu-wave4:" + "/".join(extra).replace("--", ""),
+                [py, BENCH, "--skip-canary", "--timeout-budget", "900"]
+                + extra,
+                1020,
+            )
+
     if on("mfu-wave3"):
         for extra in (
             ["--preset", "llama-1b", "--optimizer", "adafactor",
