@@ -73,6 +73,15 @@ class ModelConfig:
     # Flash-attention block sizes (tuned for TPU MXU/VMEM; 0 = auto)
     flash_block_q: int = 0
     flash_block_kv: int = 0
+    # Heads-major (B, H, T, Dh) q/k/v for the flash TRAINING path: produced
+    # straight from the projection einsum so the kernel fold is a reshape,
+    # not a transpose. Default OFF: the op-level profile showed ~6% of the
+    # step in relayout copies around the pallas calls, but the heads-major
+    # program measured consistently ~1% SLOWER on v5e (2026-08-01:
+    # 124m 43.1 vs 43.8, 1B 46.6 vs 47.0, 350M 42.6 vs 43.0) — XLA moves
+    # the layout pressure into the out-projection/residual side. Kept as a
+    # probe knob for other hardware/shapes.
+    flash_heads_major: bool = False
     # Rematerialization policy applied to each scanned block — see
     # ops/remat.py for what each saves.
     remat: str = "none"  # none | full | dots_saveable | save_attn | save_qkv_attn | save_big

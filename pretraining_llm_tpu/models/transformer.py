@@ -187,28 +187,49 @@ def _attention_block(
     """
     cdt = jnp.dtype(cfg.compute_dtype)
     h = layers.apply_norm(cfg.norm, blk["ln1"], x, cfg.norm_eps)
+    # HEADS-MAJOR training layout for the flash kernel (opt-in probe knob,
+    # measured ~1% slower on v5e despite removing the per-call relayout
+    # copies — see ModelConfig.flash_heads_major for the numbers): q/k/v
+    # produced (B, H, T, Dh) straight from the projection einsum, kernel
+    # fold becomes a free reshape. Cached decode and the other impls keep
+    # the (B, T, H, Dh) convention.
+    hm = (
+        kv is None
+        and cfg.attention_impl == "flash"
+        and cfg.flash_heads_major
+    )
     if "wqkv" in blk["attn"]:
         qkv = jnp.einsum(
-            "btd,dchn->bcthn", h.astype(cdt), blk["attn"]["wqkv"].astype(cdt),
+            "btd,dchn->bchtn" if hm else "btd,dchn->bcthn",
+            h.astype(cdt), blk["attn"]["wqkv"].astype(cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         if "bqkv" in blk["attn"]:
-            qkv = qkv + blk["attn"]["bqkv"].astype(cdt)[None, :, None, :, :]
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, T, H, Dh)
+            bqkv = blk["attn"]["bqkv"].astype(cdt)  # (3, H, Dh)
+            qkv = qkv + (
+                bqkv[None, :, :, None, :] if hm else bqkv[None, :, None, :, :]
+            )
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # hm: (B, H, T, Dh)
     else:
         # GQA: H query heads, kv_heads <= H key/value heads.
         q = jnp.einsum(
-            "btd,dhn->bthn", h.astype(cdt), blk["attn"]["wq"].astype(cdt),
+            "btd,dhn->bhtn" if hm else "btd,dhn->bthn",
+            h.astype(cdt), blk["attn"]["wq"].astype(cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         kvp = jnp.einsum(
-            "btd,dcgn->bctgn", h.astype(cdt), blk["attn"]["wkv"].astype(cdt),
+            "btd,dcgn->bcgtn" if hm else "btd,dcgn->bctgn",
+            h.astype(cdt), blk["attn"]["wkv"].astype(cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         if "bq" in blk["attn"]:
-            q = q + blk["attn"]["bq"].astype(cdt)[None, None]
-            kvp = kvp + blk["attn"]["bkv"].astype(cdt)[None, :, None]
-        k, v = kvp[:, 0], kvp[:, 1]  # (B, T, G, Dh)
+            bq = blk["attn"]["bq"].astype(cdt)  # (H, Dh)
+            bkv = blk["attn"]["bkv"].astype(cdt)  # (2, G, Dh)
+            q = q + (bq[None, :, None, :] if hm else bq[None, None])
+            kvp = kvp + (
+                bkv[None, :, :, None, :] if hm else bkv[None, :, None]
+            )
+        k, v = kvp[:, 0], kvp[:, 1]  # hm: (B, G, T, Dh)
 
     if rope is not None:
         cos, sin = rope
@@ -222,8 +243,8 @@ def _attention_block(
             rope_pos = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
         else:
             rope_pos = positions
-        q = layers.apply_rope(q, cos, sin, rope_pos)
-        k = layers.apply_rope(k, cos, sin, rope_pos)
+        q = layers.apply_rope(q, cos, sin, rope_pos, seq_axis=2 if hm else 1)
+        k = layers.apply_rope(k, cos, sin, rope_pos, seq_axis=2 if hm else 1)
 
     # Remat tags for the 'save_qkv_attn'/'save_big' policies: with post-RoPE
     # q/k/v saved, the attention backward starts directly from its VJP inputs
@@ -475,19 +496,24 @@ def _attention_block(
             ring_layout="zigzag" if zigzag else "contiguous",
             segments=segments,
             window=cfg.sliding_window,
+            heads_major=hm,
         )
 
     # Tag for the 'save_attn' remat policy: keep the (cheap-to-store,
     # expensive-to-recompute) attention output, recompute everything else.
+    # (Heads-major path saves (B, H, T, Dh) — consumers below match.)
     out = checkpoint_name(out, "attn_out")
 
     if cfg.use_output_proj:
         out = jnp.einsum(
-            "bthn,hnd->btd", out, blk["attn"]["wo"].astype(cdt),
+            "bhtn,hnd->btd" if hm else "bthn,hnd->btd",
+            out, blk["attn"]["wo"].astype(cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt) + blk["attn"]["bo"].astype(cdt)
     else:
         # Reference shape (attention.py:95): concat heads is the output.
+        if hm:
+            out = out.transpose(0, 2, 1, 3)
         b, t = out.shape[:2]
         out = out.reshape(b, t, cfg.n_heads * cfg.head_dim)
     return x + out.astype(x.dtype), new_kv

@@ -210,6 +210,7 @@ def flash_attention(
     block_kv: int = 0,
     segments: Any = None,
     window: int = 0,
+    heads_major: bool = False,
 ) -> jax.Array:
     """Memory-efficient attention; Pallas kernel on TPU, blockwise JAX elsewhere.
 
@@ -217,14 +218,32 @@ def flash_attention(
     GQA natively (query groups index shared KV blocks); the blockwise
     fallback is GQA-native too (grouped einsums, K/V never expanded).
 
+    ``heads_major=True``: operands arrive (B, H|G, T, D) and the result is
+    returned (B, H, T, D) — the kernel-native layout, letting the training
+    path skip the per-layer transpose copies (see pallas_flash_attention).
+    The single-device and fully-manual Pallas tiers consume it natively;
+    the shard_map and blockwise tiers transpose at entry/exit (mesh/CPU
+    paths — correctness over the last few percent there).
+
     ``segments`` (B, T) int32 document ids: packed-sequence training —
     attention (and its VJP) never crosses a document boundary. Threaded
     into whichever tier serves the call.
     """
-    if q.shape[2] % k.shape[2] != 0:
+    head_ax = 1 if heads_major else 2
+    if q.shape[head_ax] % k.shape[head_ax] != 0:
         # Same fail-fast the Pallas path gives; without it the CPU fallback
         # dies in an unrelated reshape.
-        raise ValueError(f"kv heads ({k.shape[2]}) must divide query heads ({q.shape[2]})")
+        raise ValueError(
+            f"kv heads ({k.shape[head_ax]}) must divide query heads "
+            f"({q.shape[head_ax]})"
+        )
+
+    def _to_btHD(x):
+        return x.transpose(0, 2, 1, 3) if heads_major else x
+
+    def _from_btHD(o):
+        return o.transpose(0, 2, 1, 3) if heads_major else o
+
     if _pallas_available():
         try:
             from pretraining_llm_tpu.ops.pallas_flash import pallas_flash_attention
@@ -236,7 +255,8 @@ def flash_attention(
             )
             mesh = current_mesh()
             if mesh is None or all(s == 1 for s in mesh.shape.values()):
-                return kernel(q, k, v, segments=segments)
+                return kernel(q, k, v, segments=segments,
+                              heads_major=heads_major)
             # Manual-region classification (ADVICE r2): the direct kernel
             # call is only correct when EVERY nontrivial mesh axis is manual
             # (ulysses' all-to-all body — operands are per-device local
@@ -254,11 +274,15 @@ def flash_attention(
             }
             nontrivial = {name for name, size in mesh.shape.items() if size > 1}
             if nontrivial <= manual_axes:
-                return kernel(q, k, v, segments=segments)  # fully manual region
+                return kernel(q, k, v, segments=segments,
+                              heads_major=heads_major)  # fully manual region
             if not manual_axes:
-                out = shard_mapped_kernel(kernel, q, k, v, mesh, segments=segments)
+                out = shard_mapped_kernel(
+                    kernel, _to_btHD(q), _to_btHD(k), _to_btHD(v), mesh,
+                    segments=segments,
+                )
                 if out is not None:
-                    return out
+                    return _from_btHD(out)
             # Partial-manual region, or unexpressible per-shard layout
             # (seq/pipe-sharded activations, indivisible batch or heads):
             # blockwise fallback below. Loud (VERDICT r2 #9) — the user
@@ -282,7 +306,7 @@ def flash_attention(
         except ImportError:
             pass  # kernel module not built yet; blockwise path is correct
     # blockwise_attention is GQA-native (grouped einsums) — no K/V expansion.
-    return blockwise_attention(
-        q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
-        segments=segments, window=window,
-    )
+    return _from_btHD(blockwise_attention(
+        _to_btHD(q), _to_btHD(k), _to_btHD(v), causal=causal,
+        block_q=block_q, block_kv=block_kv, segments=segments, window=window,
+    ))
