@@ -113,6 +113,18 @@ class ModelConfig:
     # scan-unroll is an unproven kernel-config class on this backend
     # (tpu_capture RISKY_STAGES).
     decode_unroll_layers: bool = False
+    # Decode KV-cache container layout. 'unstacked' (default): a tuple of
+    # per-layer (B, T, G, Dh) caches with a trace-time python loop over
+    # layers — each leaf is updated in place via one dynamic-update-slice
+    # on the token-scan carry (the aliasable pattern). 'stacked': one
+    # (L, B, T, G, Dh) array per field riding the depth scan — profiled on
+    # v5e at ~50% of the decode step in pure cache MOVEMENT (the scan's
+    # ys-stacking makes a fresh (L, ...) buffer every token step, so the
+    # token-scan carry cannot alias and XLA copies the whole cache back
+    # in, plus per-layer slice/update-slice relayouts). Measured
+    # 2026-08-01 at gpt2-124m b8: unstacked 6,856 tok/s vs stacked 4,129
+    # (+66%). Semantics identical (tested: greedy/ragged/int8).
+    decode_cache_layout: str = "unstacked"
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
     # Sliding-window attention (Mistral-style): each query attends only the
@@ -174,6 +186,20 @@ class ModelConfig:
             )
         if self.remat not in _REMAT_POLICIES:
             raise ValueError(f"remat must be one of {_REMAT_POLICIES}, got {self.remat!r}")
+        if self.decode_cache_layout not in ("stacked", "unstacked"):
+            raise ValueError(
+                "decode_cache_layout must be 'stacked' or 'unstacked', got "
+                f"{self.decode_cache_layout!r}"
+            )
+        if self.decode_unroll_layers and self.decode_cache_layout != "stacked":
+            # The unroll knob only means something on the stacked depth
+            # scan; silently ignoring it under the unstacked layout would
+            # bank mislabeled measurements.
+            raise ValueError(
+                "decode_unroll_layers requires decode_cache_layout="
+                "'stacked' (the unstacked layout has no depth scan to "
+                "unroll)"
+            )
         if self.ce_impl not in ("chunked", "fused", "dense"):
             raise ValueError(
                 f"ce_impl must be 'chunked', 'fused' or 'dense', got {self.ce_impl!r}"

@@ -167,12 +167,21 @@ def _generate_jit(
             src = jnp.clip(
                 jnp.arange(total)[None, :] - pad_off[:, None], 0, total - 1
             )  # (B, total)
-            cache = jax.tree.map(
-                lambda c: jnp.take_along_axis(
-                    c, src[None, :, :, None, None], axis=2
-                ),
-                cache,
-            )
+            if "layers" in cache:
+                # Unstacked layout: per-layer leaves are (B, T, ...).
+                cache = jax.tree.map(
+                    lambda c: jnp.take_along_axis(
+                        c, src[:, :, None, None], axis=1
+                    ),
+                    cache,
+                )
+            else:
+                cache = jax.tree.map(
+                    lambda c: jnp.take_along_axis(
+                        c, src[None, :, :, None, None], axis=2
+                    ),
+                    cache,
+                )
             start_index = jnp.int32(bucket)
         next_tok = sample_logits(
             last, sub, temperature=temperature, top_k=top_k, top_p=top_p,

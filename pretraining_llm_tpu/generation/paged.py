@@ -118,14 +118,23 @@ def _scatter_pages(
     """Scatter a (L, 1, n_pages*bs, ...) dense prefill cache into the pools
     at ``block_ids``. Donated pools: the update is in-place on device."""
     out = dict(pools)
+    scattered = 0
     for dense_key, pool_key in _POOL_OF_DENSE.items():
         if dense_key not in dense_cache:
             continue
+        scattered += 1
         buf = dense_cache[dense_key][:, 0]  # (L, n_pages*bs, ...)
         tail = buf.shape[2:]
         pages = buf.reshape((buf.shape[0], n_pages, -1) + tail)
         out[pool_key] = pools[pool_key].at[:, block_ids].set(
             pages.astype(pools[pool_key].dtype)
+        )
+    if not scattered:
+        # A container-layout mismatch (e.g. an unstacked staging cache)
+        # would otherwise silently prefill NOTHING and serve garbage.
+        raise ValueError(
+            f"no cache fields matched the pool mapping; staging cache keys "
+            f"= {sorted(dense_cache)} (need the stacked layout)"
         )
     return out
 
@@ -147,10 +156,17 @@ def _prefill_dense(
     decode write to slot seq_len lands BEFORE the mask exposes it, exactly
     the dense-prefill overwrite discipline (`generate._generate_jit`).
     """
+    import dataclasses as _dc
+
     from pretraining_llm_tpu.parallel.sharding import activation_mesh
 
     with activation_mesh(mesh):
-        cache = transformer.make_kv_cache(cfg, 1, p_bucket)
+        # The staging cache is consumed field-by-field by _scatter_pages
+        # (reshape (L, 1, pages*bs, ...) -> pool pages), which needs the
+        # STACKED container regardless of the model's decode default.
+        cache = transformer.make_kv_cache(
+            _dc.replace(cfg, decode_cache_layout="stacked"), 1, p_bucket
+        )
         logits, cache = transformer.forward(
             params, prompt, cfg, kv_cache=cache, cache_index=jnp.int32(0)
         )

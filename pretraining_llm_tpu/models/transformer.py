@@ -776,12 +776,40 @@ def forward(
             body, (x, aux0), params["blocks"], unroll=cfg.scan_unroll
         )
         new_cache = None
+    elif "layers" in kv_cache:
+        # UNSTACKED decode cache (decode_cache_layout='unstacked'):
+        # trace-time python loop over layers, each layer's (B, T, G, Dh)
+        # cache leaves updated by ONE dynamic-update-slice directly on the
+        # token-scan carry — the aliasable pattern, eliminating both the
+        # stacked layout's whole-cache carry copies and its per-layer
+        # slice/update-slice relayouts (together ~50% of the profiled v5e
+        # decode step). Layer weights come from static slices of the
+        # stacked block params (fold into their consumers, no copies).
+        aux_total = aux0
+        new_layers = []
+        for layer in range(cfg.n_layers):
+            blk = jax.tree.map(
+                lambda a, _l=layer: jax.lax.index_in_dim(
+                    a, _l, 0, keepdims=False
+                ),
+                params["blocks"],
+            )
+            x, new_kv, aux = _block(
+                blk, x, cfg, rope, positions, kv_cache["layers"][layer],
+                cache_index, pad_offsets=pad_offsets, paged=paged,
+            )
+            aux_total = aux_total + aux
+            new_layers.append(new_kv)
+        new_cache = {"layers": tuple(new_layers)}
     else:
         # Single-token decode steps may fully unroll the depth scan: the
         # rolled inner while forces XLA to copy the whole cache at the
         # token-scan loop boundary every step (see ModelConfig.
         # decode_unroll_layers). Tq is a static shape, so this is a
         # trace-time choice; prefill (Tq>1) keeps the rolled scan.
+        # (On-chip 2026-08-01: unroll measured SLOWER than the rolled scan
+        # — the unstacked cache layout above is the measured fix for the
+        # carry-copy problem instead.)
         unroll = (
             cfg.n_layers
             if cfg.decode_unroll_layers and x.shape[1] == 1
@@ -1171,6 +1199,21 @@ def loss_fn(
 def make_kv_cache(
     cfg: ModelConfig, batch_size: int, max_length: int, dtype: Any = None
 ) -> KVCache:
+    """Decode cache in the layout ``cfg.decode_cache_layout`` selects:
+    stacked {(L, B, T, G, Dh)} fields, or {'layers': (per-layer dicts of
+    (B, T, G, Dh) fields,)} — see the config field for the v5e profile
+    evidence behind the unstacked option."""
+    if cfg.decode_cache_layout == "unstacked":
+        import dataclasses as _dc
+
+        stacked_cfg = _dc.replace(cfg, decode_cache_layout="stacked")
+        stacked = make_kv_cache(stacked_cfg, batch_size, max_length, dtype)
+        return {
+            "layers": tuple(
+                {name: buf[layer] for name, buf in stacked.items()}
+                for layer in range(cfg.n_layers)
+            )
+        }
     if max_length > cfg.context_length:
         # Position tables (learned or RoPE) are sized by context_length; JAX
         # gather would silently clamp out-of-range positions — fail fast here.

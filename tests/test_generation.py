@@ -21,6 +21,44 @@ def params():
     return transformer.init_params(CFG, jax.random.key(0))
 
 
+def test_unstacked_cache_layout_matches_stacked(params):
+    """decode_cache_layout='unstacked' (per-layer caches, python layer
+    loop, in-place carry updates) must generate EXACTLY the stacked
+    layout's tokens — greedy, ragged rows, and int8 quantized."""
+    cfg_u = dataclasses.replace(CFG, decode_cache_layout="unstacked")
+    prompt = jax.random.randint(jax.random.key(11), (2, 9), 0, CFG.vocab_size)
+    want = np.asarray(
+        generate(params, CFG, prompt, 12, jax.random.key(3), temperature=0.0)
+    )
+    got = np.asarray(
+        generate(params, cfg_u, prompt, 12, jax.random.key(3), temperature=0.0)
+    )
+    np.testing.assert_array_equal(got, want)
+
+    # Ragged rows exercise the per-layer cache roll after prefill.
+    lengths = np.asarray([5, 9], np.int32)
+    want_r = np.asarray(generate(
+        params, CFG, prompt, 8, jax.random.key(4), temperature=0.0,
+        prompt_lengths=lengths,
+    ))
+    got_r = np.asarray(generate(
+        params, cfg_u, prompt, 8, jax.random.key(4), temperature=0.0,
+        prompt_lengths=lengths,
+    ))
+    np.testing.assert_array_equal(got_r, want_r)
+
+    # int8 quantized cache leaves carry through the unstacked container.
+    cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    cfg8_u = dataclasses.replace(cfg8, decode_cache_layout="unstacked")
+    want_q = np.asarray(
+        generate(params, cfg8, prompt, 8, jax.random.key(5), temperature=0.0)
+    )
+    got_q = np.asarray(
+        generate(params, cfg8_u, prompt, 8, jax.random.key(5), temperature=0.0)
+    )
+    np.testing.assert_array_equal(got_q, want_q)
+
+
 def test_greedy_cached_matches_uncached(params):
     """KV-cached greedy decode must equal argmax over full re-forwards
     (the reference's cache-less loop, transformer.py:96-114)."""
@@ -291,9 +329,16 @@ def test_generate_decode_unroll_equals_rolled_greedy(params):
     """decode_unroll_layers only changes the compiled loop structure (no
     inner while -> no per-step cache copies); greedy output must be
     bit-identical to the rolled depth scan."""
-    cfg_unroll = dataclasses.replace(CFG, decode_unroll_layers=True)
+    # The unroll knob is stacked-only (the unstacked default has no depth
+    # scan to unroll — config validation rejects the combination).
+    cfg_stacked = dataclasses.replace(CFG, decode_cache_layout="stacked")
+    cfg_unroll = dataclasses.replace(cfg_stacked, decode_unroll_layers=True)
+    with pytest.raises(ValueError, match="decode_unroll_layers requires"):
+        dataclasses.replace(CFG, decode_unroll_layers=True)
     prompt = jax.random.randint(jax.random.key(16), (2, 8), 0, CFG.vocab_size)
-    got_r = np.asarray(generate(params, CFG, prompt, 8, jax.random.key(7), temperature=0.0))
+    got_r = np.asarray(
+        generate(params, cfg_stacked, prompt, 8, jax.random.key(7), temperature=0.0)
+    )
     got_u = np.asarray(
         generate(params, cfg_unroll, prompt, 8, jax.random.key(7), temperature=0.0)
     )

@@ -33,8 +33,14 @@ def test_quantize_roundtrip_error_bound():
 
 
 def test_int8_cache_structure_and_memory():
-    cache = transformer.make_kv_cache(CFG, 2, 32)
+    # Structure assertions target the STACKED container explicitly (the
+    # model default is the unstacked per-layer tuple, same fields/leaves).
+    stacked_cfg = dataclasses.replace(CFG, decode_cache_layout="stacked")
+    cache = transformer.make_kv_cache(stacked_cfg, 2, 32)
     assert set(cache) == {"k", "v", "k_scale", "v_scale"}
+    un = transformer.make_kv_cache(CFG, 2, 32)
+    assert set(un) == {"layers"} and len(un["layers"]) == CFG.n_layers
+    assert set(un["layers"][0]) == {"k", "v", "k_scale", "v_scale"}
     assert cache["k"].dtype == jnp.int8
     assert cache["k_scale"].shape == cache["k"].shape[:-1] + (1,)
     # vs bf16 cache: ~1.9x smaller at Dh=64 (1 + 4/Dh bytes vs 2 per elem).
