@@ -311,7 +311,6 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "--optimizer": args.optimizer, "--unroll": args.unroll,
         "--block-q": args.block_q, "--block-kv": args.block_kv,
         "--ragged": args.ragged, "--decode-unroll": args.decode_unroll,
-        "--cache-layout": args.cache_layout,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -320,6 +319,11 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
     cfg = get_preset(args.preset).model
     if args.kv_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+    if args.cache_layout:
+        # Controls the POOL container too (make_paged_kv_pool honors
+        # decode_cache_layout) — 'stacked' reproduces the historical
+        # serving series.
+        cfg = dataclasses.replace(cfg, decode_cache_layout=args.cache_layout)
     max_batch = args.batch or 8
     if args.quick:
         max_batch = min(max_batch, 4)
@@ -375,6 +379,9 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
     }
     if cfg.kv_cache_dtype == "int8":
         rec["metric"] += "_kvint8"
+    if cfg.decode_cache_layout == "unstacked":
+        rec["metric"] += "_unstacked"  # distinct series vs stacked pools
+        rec["decode_cache_layout"] = "unstacked"
     return rec
 
 
@@ -659,6 +666,8 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         metric = f"serving_tokens_per_sec_{args.preset}"
         if args.kv_dtype == "int8":
             metric += "_kvint8"
+        if args.cache_layout != "stacked":  # effective default: unstacked
+            metric += "_unstacked"
         unit = "generated_tokens_per_sec"
     else:
         metric, unit = f"mfu_{args.preset}_train", "fraction_of_peak_bf16"

@@ -116,27 +116,45 @@ def _scatter_pages(
     n_pages: int,
 ) -> transformer.KVCache:
     """Scatter a (L, 1, n_pages*bs, ...) dense prefill cache into the pools
-    at ``block_ids``. Donated pools: the update is in-place on device."""
-    out = dict(pools)
-    scattered = 0
-    for dense_key, pool_key in _POOL_OF_DENSE.items():
-        if dense_key not in dense_cache:
-            continue
-        scattered += 1
-        buf = dense_cache[dense_key][:, 0]  # (L, n_pages*bs, ...)
-        tail = buf.shape[2:]
-        pages = buf.reshape((buf.shape[0], n_pages, -1) + tail)
-        out[pool_key] = pools[pool_key].at[:, block_ids].set(
-            pages.astype(pools[pool_key].dtype)
-        )
-    if not scattered:
-        # A container-layout mismatch (e.g. an unstacked staging cache)
-        # would otherwise silently prefill NOTHING and serve garbage.
-        raise ValueError(
-            f"no cache fields matched the pool mapping; staging cache keys "
-            f"= {sorted(dense_cache)} (need the stacked layout)"
-        )
-    return out
+    (stacked or unstacked container) at ``block_ids``. Donated pools: the
+    update is in-place on device."""
+    unstacked = "layers" in pools
+
+    def _fields(layer_pool, dense_layer):
+        out = dict(layer_pool)
+        scattered = 0
+        for dense_key, pool_key in _POOL_OF_DENSE.items():
+            if dense_key not in dense_cache:
+                continue
+            scattered += 1
+            buf = dense_layer(dense_cache[dense_key])  # (pages*bs, ...) or (L, pages*bs, ...)
+            lead = buf.shape[: buf.ndim - 3]  # () unstacked, (L,) stacked
+            tail = buf.shape[-2:]
+            pages = buf.reshape(lead + (n_pages, -1) + tail)
+            idx = (block_ids,) if not lead else (slice(None), block_ids)
+            out[pool_key] = layer_pool[pool_key].at[idx].set(
+                pages.astype(layer_pool[pool_key].dtype)
+            )
+        if not scattered:
+            # A container-layout mismatch (e.g. an unstacked staging
+            # cache) would otherwise silently prefill NOTHING.
+            raise ValueError(
+                f"no cache fields matched the pool mapping; staging cache "
+                f"keys = {sorted(dense_cache)} (need the stacked layout)"
+            )
+        return out
+
+    if unstacked:
+        return {
+            "layers": tuple(
+                _fields(
+                    pools["layers"][layer],
+                    lambda buf, _l=layer: buf[_l, 0],
+                )
+                for layer in range(len(pools["layers"]))
+            )
+        }
+    return _fields(pools, lambda buf: buf[:, 0])
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "p_bucket", "mesh"))
@@ -192,7 +210,10 @@ def prefill_into_pool(
     (allocator output). Returns (last-token logits (V,) fp32, updated
     pools). Compiles once per page count, not per prompt length.
     """
-    block_size = int(pools["k_pool"].shape[2])
+    if "layers" in pools:
+        block_size = int(pools["layers"][0]["k_pool"].shape[1])
+    else:
+        block_size = int(pools["k_pool"].shape[2])
     p = len(prompt_ids)
     if p == 0:
         raise ValueError("empty prompt")

@@ -137,15 +137,19 @@ class ServingEngine:
 
             tp = mesh.shape.get("tensor", 1)
             head_ax = "tensor" if (tp > 1 and cfg.kv_heads % tp == 0) else None
-            self.pools = jax.device_put(
-                self.pools,
-                {
-                    k: NamedSharding(
+            # Every pool leaf carries kv_heads at axis -2 (scale pools have
+            # a trailing 1); stacked leaves are 5-dim, unstacked 4-dim.
+            self.pools = jax.tree.map(
+                lambda leaf: jax.device_put(
+                    leaf,
+                    NamedSharding(
                         mesh,
-                        PartitionSpec(None, None, None, head_ax, None),
-                    )
-                    for k in self.pools
-                },
+                        PartitionSpec(
+                            *([None] * (leaf.ndim - 2)), head_ax, None
+                        ),
+                    ),
+                ),
+                self.pools,
             )
         self.alloc = paged.BlockAllocator(n_blocks)
         self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)

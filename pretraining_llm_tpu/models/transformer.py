@@ -657,7 +657,7 @@ def forward(
             "layout; training/eval calls must not pass it"
         )
     if paged is not None:
-        if kv_cache is None or "k_pool" not in kv_cache:
+        if not _is_pool_cache(kv_cache):
             raise ValueError(
                 "paged=PagedInfo requires a pool-layout kv_cache "
                 "(make_paged_kv_pool)"
@@ -672,7 +672,7 @@ def forward(
                 "pad_offsets is the contiguous ragged layout; paged rows "
                 "are ragged natively via seq_lens"
             )
-    elif kv_cache is not None and "k_pool" in kv_cache:
+    elif _is_pool_cache(kv_cache):
         raise ValueError(
             "a pool-layout kv_cache requires forward(..., paged=PagedInfo)"
         )
@@ -785,22 +785,43 @@ def forward(
         # slice/update-slice relayouts (together ~50% of the profiled v5e
         # decode step). Layer weights come from static slices of the
         # stacked block params (fold into their consumers, no copies).
-        aux_total = aux0
-        new_layers = []
-        for layer in range(cfg.n_layers):
-            blk = jax.tree.map(
-                lambda a, _l=layer: jax.lax.index_in_dim(
-                    a, _l, 0, keepdims=False
-                ),
-                params["blocks"],
+        if t > 1:
+            # PREFILL: the carry-copy pathology is per decode STEP; a
+            # python layer loop here would only scale the prefill program
+            # (and its compile time) by n_layers. Re-stack, run the rolled
+            # scan once, unstack the result — two whole-cache copies per
+            # prefill, amortized over the entire generation.
+            stacked_cache = {
+                name: jnp.stack([lyr[name] for lyr in kv_cache["layers"]])
+                for name in kv_cache["layers"][0]
+            }
+            (x, aux_total), new_stacked = jax.lax.scan(
+                body, (x, aux0), (params["blocks"], stacked_cache),
+                unroll=cfg.scan_unroll,
             )
-            x, new_kv, aux = _block(
-                blk, x, cfg, rope, positions, kv_cache["layers"][layer],
-                cache_index, pad_offsets=pad_offsets, paged=paged,
-            )
-            aux_total = aux_total + aux
-            new_layers.append(new_kv)
-        new_cache = {"layers": tuple(new_layers)}
+            new_cache = {
+                "layers": tuple(
+                    {name: buf[layer] for name, buf in new_stacked.items()}
+                    for layer in range(cfg.n_layers)
+                )
+            }
+        else:
+            aux_total = aux0
+            new_layers = []
+            for layer in range(cfg.n_layers):
+                blk = jax.tree.map(
+                    lambda a, _l=layer: jax.lax.index_in_dim(
+                        a, _l, 0, keepdims=False
+                    ),
+                    params["blocks"],
+                )
+                x, new_kv, aux = _block(
+                    blk, x, cfg, rope, positions, kv_cache["layers"][layer],
+                    cache_index, pad_offsets=pad_offsets, paged=paged,
+                )
+                aux_total = aux_total + aux
+                new_layers.append(new_kv)
+            new_cache = {"layers": tuple(new_layers)}
     else:
         # Single-token decode steps may fully unroll the depth scan: the
         # rolled inner while forces XLA to copy the whole cache at the
@@ -1196,6 +1217,32 @@ def loss_fn(
     return loss
 
 
+def _is_pool_cache(kv_cache: Optional[KVCache]) -> bool:
+    """True for a paged POOL container (stacked or unstacked layout)."""
+    return kv_cache is not None and (
+        "k_pool" in kv_cache
+        or ("layers" in kv_cache and "k_pool" in kv_cache["layers"][0])
+    )
+
+
+def _unstack_fields(n_layers: int, fields: Dict[str, Tuple[Tuple[int, ...], Any]]) -> KVCache:
+    """{'layers': per-layer dicts of fresh zero arrays} from {name:
+    (stacked_shape, dtype)} specs — allocated per layer DIRECTLY (never
+    materializing the stacked array first: pools are sized toward HBM
+    capacity, and a transient 2x would OOM engines that otherwise fit).
+    Each layer gets its own buffers (sharing one zeros across carry
+    leaves would alias donated updates)."""
+    return {
+        "layers": tuple(
+            {
+                name: jnp.zeros(shape[1:], dt)
+                for name, (shape, dt) in fields.items()
+            }
+            for _ in range(n_layers)
+        )
+    }
+
+
 def make_kv_cache(
     cfg: ModelConfig, batch_size: int, max_length: int, dtype: Any = None
 ) -> KVCache:
@@ -1203,17 +1250,6 @@ def make_kv_cache(
     stacked {(L, B, T, G, Dh)} fields, or {'layers': (per-layer dicts of
     (B, T, G, Dh) fields,)} — see the config field for the v5e profile
     evidence behind the unstacked option."""
-    if cfg.decode_cache_layout == "unstacked":
-        import dataclasses as _dc
-
-        stacked_cfg = _dc.replace(cfg, decode_cache_layout="stacked")
-        stacked = make_kv_cache(stacked_cfg, batch_size, max_length, dtype)
-        return {
-            "layers": tuple(
-                {name: buf[layer] for name, buf in stacked.items()}
-                for layer in range(cfg.n_layers)
-            )
-        }
     if max_length > cfg.context_length:
         # Position tables (learned or RoPE) are sized by context_length; JAX
         # gather would silently clamp out-of-range positions — fail fast here.
@@ -1236,14 +1272,18 @@ def make_kv_cache(
         # Persistent cache bytes per element: 1 + 4/Dh vs 2 (bf16) — ~1.9x
         # smaller at Dh=64; the transient dequant is per-layer, per-step.
         sshape = shape[:-1] + (1,)
-        return {
-            "k": jnp.zeros(shape, jnp.int8),
-            "v": jnp.zeros(shape, jnp.int8),
-            "k_scale": jnp.zeros(sshape, jnp.float32),
-            "v_scale": jnp.zeros(sshape, jnp.float32),
+        fields = {
+            "k": (shape, jnp.int8),
+            "v": (shape, jnp.int8),
+            "k_scale": (sshape, jnp.float32),
+            "v_scale": (sshape, jnp.float32),
         }
-    dtype = jnp.dtype(dtype or cfg.compute_dtype)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    else:
+        dtype = jnp.dtype(dtype or cfg.compute_dtype)
+        fields = {"k": (shape, dtype), "v": (shape, dtype)}
+    if cfg.decode_cache_layout == "unstacked":
+        return _unstack_fields(cfg.n_layers, fields)
+    return {name: jnp.zeros(s, dt) for name, (s, dt) in fields.items()}
 
 
 def make_paged_kv_pool(
@@ -1271,17 +1311,21 @@ def make_paged_kv_pool(
                 "kv_cache_dtype='int8'"
             )
         sshape = shape[:-1] + (1,)
-        return {
-            "k_pool": jnp.zeros(shape, jnp.int8),
-            "v_pool": jnp.zeros(shape, jnp.int8),
-            "k_scale_pool": jnp.zeros(sshape, jnp.float32),
-            "v_scale_pool": jnp.zeros(sshape, jnp.float32),
+        fields = {
+            "k_pool": (shape, jnp.int8),
+            "v_pool": (shape, jnp.int8),
+            "k_scale_pool": (sshape, jnp.float32),
+            "v_scale_pool": (sshape, jnp.float32),
         }
-    dtype = jnp.dtype(dtype or cfg.compute_dtype)
-    return {
-        "k_pool": jnp.zeros(shape, dtype),
-        "v_pool": jnp.zeros(shape, dtype),
-    }
+    else:
+        dtype = jnp.dtype(dtype or cfg.compute_dtype)
+        fields = {"k_pool": (shape, dtype), "v_pool": (shape, dtype)}
+    if cfg.decode_cache_layout == "unstacked":
+        # Same carry-aliasing rationale as the dense unstacked cache
+        # (see decode_cache_layout): per-layer pools update in place on
+        # the serving window's token-scan carry.
+        return _unstack_fields(cfg.n_layers, fields)
+    return {name: jnp.zeros(s, dt) for name, (s, dt) in fields.items()}
 
 
 def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:

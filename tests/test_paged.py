@@ -91,8 +91,16 @@ def test_forward_paged_validation(params):
 
 
 def test_pool_shape_and_reserved_block():
+    # Default container is unstacked (per-layer pools, carry-aliasable).
     pools = transformer.make_paged_kv_pool(CFG, 6, 8)
-    assert pools["k_pool"].shape == (
+    assert set(pools) == {"layers"} and len(pools["layers"]) == CFG.n_layers
+    assert pools["layers"][0]["k_pool"].shape == (
+        6, 8, CFG.kv_heads, CFG.head_dim
+    )
+    stacked = transformer.make_paged_kv_pool(
+        dataclasses.replace(CFG, decode_cache_layout="stacked"), 6, 8
+    )
+    assert stacked["k_pool"].shape == (
         CFG.n_layers, 6, 8, CFG.kv_heads, CFG.head_dim
     )
     with pytest.raises(ValueError, match="multiple of 8"):
