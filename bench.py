@@ -99,6 +99,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
+        "--context", type=int, default=0,
+        help="train mode: context_length override (long-context probes; "
+        "RoPE presets extrapolate — learned-position presets are rejected "
+        "since their tables are sized by the original context)",
+    )
+    parser.add_argument(
         "--cache-layout", default="", choices=["", "stacked", "unstacked"],
         help="decode mode: KV-cache container layout override. 'unstacked' "
         "(the model default; measured 6,856 vs 4,129 tok/s on v5e "
@@ -213,6 +219,7 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "--optimizer": args.optimizer, "--unroll": args.unroll,
         "--block-q": args.block_q, "--block-kv": args.block_kv,
         "--steps-per-sched": args.steps_per_sched,
+        "--context": args.context,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -311,6 +318,7 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "--optimizer": args.optimizer, "--unroll": args.unroll,
         "--block-q": args.block_q, "--block-kv": args.block_kv,
         "--ragged": args.ragged, "--decode-unroll": args.decode_unroll,
+        "--context": args.context,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -393,7 +401,8 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
     noop = {"--ragged": args.ragged, "--kv-dtype": args.kv_dtype,
             "--decode-unroll": args.decode_unroll,
             "--steps-per-sched": args.steps_per_sched,
-            "--cache-layout": args.cache_layout}
+            "--cache-layout": args.cache_layout,
+            "--context": args.context}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the trainer path")
@@ -520,6 +529,16 @@ def run_bench(args: argparse.Namespace) -> dict:
 
     cfg = get_preset(args.preset)
     model = cfg.model
+    if args.context:
+        if model.pos_embed != "rope":
+            raise ValueError(
+                "--context requires a RoPE preset (learned position tables "
+                "are sized by the original context_length)"
+            )
+        if args.context == model.context_length:
+            args.context = 0  # preset default: same series, no _ctx suffix
+        else:
+            model = dataclasses.replace(model, context_length=args.context)
     if args.attention:
         model = dataclasses.replace(model, attention_impl=args.attention)
     elif model.attention_impl == "ring":
@@ -623,7 +642,8 @@ def run_bench(args: argparse.Namespace) -> dict:
     mfu = tok_per_sec * flops_per_token / peak
 
     return {
-        "metric": f"mfu_{cfg.name}_train",
+        "metric": f"mfu_{cfg.name}_train"
+        + (f"_ctx{model.context_length}" if args.context else ""),
         "value": round(mfu, 4),
         "unit": "fraction_of_peak_bf16",
         "vs_baseline": round(mfu / 0.50, 4),
@@ -671,6 +691,8 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         unit = "generated_tokens_per_sec"
     else:
         metric, unit = f"mfu_{args.preset}_train", "fraction_of_peak_bf16"
+        if args.context:
+            metric += f"_ctx{args.context}"
     return {
         "metric": metric,
         "value": 0.0,
@@ -795,6 +817,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--steps-per-sched", str(args.steps_per_sched)]
     if args.cache_layout:
         cmd += ["--cache-layout", args.cache_layout]
+    if args.context:
+        cmd += ["--context", str(args.context)]
     if args.attention or attention:
         cmd += ["--attention", args.attention or attention]
     if args.ce or ce_override:

@@ -259,7 +259,7 @@ def main() -> int:
         "ctx8k", "trainer",
         "parity-tpu", "sweep-full", "sweep2", "profile", "profile-decode",
         "e2e", "batch-sweep", "unroll-sweep", "mfu-350m", "mfu-1b",
-        "mfu-1b-ladder", "serving", "mfu-wave3", "mfu-wave4",
+        "mfu-1b-ladder", "serving", "mfu-wave3", "mfu-wave4", "ctx16k",
     }
     want = None
     if args.stages:
@@ -608,6 +608,20 @@ def _run_stages(args, on, gated, risky, py) -> None:
              "--timeout-budget", "1200"],
             1320,
         )
+
+    # 8a'. 16k-context probe (2026-08-01): the 8k preset's RoPE
+    # extrapolates; --context 16384 doubles the sequence on one chip
+    # (flash auto-block is the proven kernel class; the grid just grows).
+    # Distinct metric series mfu_gpt2-8k-sp_train_ctx16384.
+    if on("ctx16k"):
+        for batch in (2, 4):
+            gated(
+                f"ctx16k/b{batch}",
+                [py, BENCH, "--skip-canary", "--preset", "gpt2-8k-sp",
+                 "--context", "16384", "--batch", str(batch),
+                 "--timeout-budget", "1200"],
+                1320,
+            )
 
     # 8b. Trainer-loop overlap: prefetch 0 vs 2 (VERDICT r2 #8 number).
     # 60 steps, not 20: the timed window holds 2 log-boundary pipeline
