@@ -614,11 +614,11 @@ def _run_stages(args, on, gated, risky, py) -> None:
     # (flash auto-block is the proven kernel class; the grid just grows).
     # Distinct metric series mfu_gpt2-8k-sp_train_ctx16384.
     if on("ctx16k"):
-        for batch in (2, 4):
+        for ctx, batch in ((16384, 2), (16384, 4), (32768, 1)):
             gated(
-                f"ctx16k/b{batch}",
+                f"ctx16k/c{ctx}/b{batch}",
                 [py, BENCH, "--skip-canary", "--preset", "gpt2-8k-sp",
-                 "--context", "16384", "--batch", str(batch),
+                 "--context", str(ctx), "--batch", str(batch),
                  "--timeout-budget", "1200"],
                 1320,
             )
