@@ -370,8 +370,41 @@ def main():
     # (this exact mistake produced a spurious "delta 1.1571 FAIL" and
     # destroyed a 1500-step record: a 300-step `--only jax` rerun compared
     # against — and clobbered — the recorded 1500-step twin).
+    # Corpus-identity guard: the harvest walks a LIVE filesystem, so a
+    # record trained in another container could (if the image ever
+    # changes) sit on DIFFERENT data than the local train.bin — a partial
+    # --only rerun would then compare curves across corpora and bank a
+    # spurious delta. Records carry the corpus sha; a mismatch against the
+    # recorded other side refuses before training. (The corpus bins are
+    # also committed now, so a fresh container gets the exact bytes.)
+    import hashlib
+
+    def _data_sha() -> str:
+        # The whole data identity the delta depends on: train stream, the
+        # val set eval_loss is measured on, and the shared initial weights
+        # (init.npz may not exist yet on a fresh jax-first run — the jax
+        # side writes it; its bytes are folded in when present).
+        h = hashlib.sha256(open(train_bin, "rb").read())
+        h.update(open(val_bin, "rb").read())
+        if os.path.exists(init_npz):
+            h.update(open(init_npz, "rb").read())
+        return h.hexdigest()
+
+    corpus_sha = _data_sha()
+
     if args.only in ("jax", "torch"):
         other = results.get({"jax": "torch", "torch": "jax"}[args.only])
+        other_sha = other.get("corpus_sha") if other else None
+        if other_sha and other_sha != corpus_sha:
+            print(json.dumps({
+                "error": f"corpus mismatch: local train.bin sha "
+                         f"{corpus_sha[:16]} != recorded "
+                         f"{'torch' if args.only == 'jax' else 'jax'} twin's "
+                         f"{other_sha[:16]}; the twins would train on "
+                         "different data — restore the recorded corpus or "
+                         "retrain BOTH sides",
+            }))
+            return 2
         so, so_exact = _steps_of(other) if other else (None, False)
         if _proven_mismatch(args.steps, True, so, so_exact):
             bound = "" if so_exact else "at least "
@@ -386,6 +419,9 @@ def main():
 
     if args.only in ("", "jax"):
         new_jax = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
+        # Recompute post-run: the jax side (re)writes init.npz — stamp the
+        # identity of what this run actually produced/used.
+        new_jax["corpus_sha"] = _data_sha()
         # A rerun on a DIFFERENT backend must not destroy the banked
         # record: the TPU pinned-precision capture is round evidence
         # (BASELINE.md parity table), and a casual CPU rerun would
@@ -405,6 +441,7 @@ def main():
         results["jax"] = new_jax
     if args.only in ("", "torch"):
         results["torch"] = run_torch(args, model_cfg, train_bin, val_bin, init_npz)
+        results["torch"]["corpus_sha"] = corpus_sha
     with open(results_path, "w") as fh:
         json.dump(results, fh, indent=2)
         fh.write("\n")
