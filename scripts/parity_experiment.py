@@ -379,30 +379,49 @@ def main():
     # also committed now, so a fresh container gets the exact bytes.)
     import hashlib
 
-    def _data_sha() -> str:
-        # The whole data identity the delta depends on: train stream, the
-        # val set eval_loss is measured on, and the shared initial weights
-        # (init.npz may not exist yet on a fresh jax-first run — the jax
-        # side writes it; its bytes are folded in when present).
+    def _file_sha(path: str) -> str:
+        return hashlib.sha256(open(path, "rb").read()).hexdigest()
+
+    def _corpus_sha() -> str:
+        # The data streams the delta depends on: the train stream and the
+        # val set eval_loss is measured on. The shared initial weights are
+        # a SEPARATE identity (init_sha): the jax side rewrites init.npz,
+        # so folding it in here would make the value depend on run order.
         h = hashlib.sha256(open(train_bin, "rb").read())
         h.update(open(val_bin, "rb").read())
-        if os.path.exists(init_npz):
-            h.update(open(init_npz, "rb").read())
         return h.hexdigest()
 
-    corpus_sha = _data_sha()
+    corpus_sha = _corpus_sha()
 
     if args.only in ("jax", "torch"):
         other = results.get({"jax": "torch", "torch": "jax"}[args.only])
         other_sha = other.get("corpus_sha") if other else None
         if other_sha and other_sha != corpus_sha:
             print(json.dumps({
-                "error": f"corpus mismatch: local train.bin sha "
+                "error": f"corpus mismatch: local train.bin+val.bin sha "
                          f"{corpus_sha[:16]} != recorded "
                          f"{'torch' if args.only == 'jax' else 'jax'} twin's "
                          f"{other_sha[:16]}; the twins would train on "
-                         "different data — restore the recorded corpus or "
-                         "retrain BOTH sides",
+                         "different data — restore the committed "
+                         "data/parity bins or retrain BOTH sides",
+            }))
+            return 2
+        # init identity: --only torch READS the local init.npz — it must
+        # be the exact weights the recorded jax twin started from.
+        other_init = other.get("init_sha") if other else None
+        if (
+            args.only == "torch"
+            and other_init
+            and os.path.exists(init_npz)
+            and _file_sha(init_npz) != other_init
+        ):
+            print(json.dumps({
+                "error": f"init mismatch: local init.npz sha "
+                         f"{_file_sha(init_npz)[:16]} != the recorded jax "
+                         f"twin's {other_init[:16]}; the torch side would "
+                         "train from different initial weights — restore "
+                         "the committed data/parity/init.npz or retrain "
+                         "BOTH sides",
             }))
             return 2
         so, so_exact = _steps_of(other) if other else (None, False)
@@ -419,9 +438,27 @@ def main():
 
     if args.only in ("", "jax"):
         new_jax = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
-        # Recompute post-run: the jax side (re)writes init.npz — stamp the
-        # identity of what this run actually produced/used.
-        new_jax["corpus_sha"] = _data_sha()
+        new_jax["corpus_sha"] = corpus_sha
+        # Post-run: the jax side (re)writes init.npz — stamp what this run
+        # actually produced, and refuse if it no longer matches what the
+        # recorded torch twin trained from (a jax-version drift would
+        # otherwise silently compare curves across different inits).
+        new_jax["init_sha"] = _file_sha(init_npz)
+        rec_torch = results.get("torch")
+        if (
+            args.only == "jax"
+            and rec_torch
+            and rec_torch.get("init_sha")
+            and rec_torch["init_sha"] != new_jax["init_sha"]
+        ):
+            print(json.dumps({
+                "error": f"init drift: this jax run regenerated init.npz "
+                         f"with sha {new_jax['init_sha'][:16]} but the "
+                         f"recorded torch twin trained from "
+                         f"{rec_torch['init_sha'][:16]} — the curves are "
+                         "not comparable; retrain BOTH sides",
+            }))
+            return 2
         # A rerun on a DIFFERENT backend must not destroy the banked
         # record: the TPU pinned-precision capture is round evidence
         # (BASELINE.md parity table), and a casual CPU rerun would
@@ -442,6 +479,9 @@ def main():
     if args.only in ("", "torch"):
         results["torch"] = run_torch(args, model_cfg, train_bin, val_bin, init_npz)
         results["torch"]["corpus_sha"] = corpus_sha
+        # Post-run: in a full run, run_jax just rewrote init.npz and torch
+        # trained from those bytes — stamp the file torch actually read.
+        results["torch"]["init_sha"] = _file_sha(init_npz)
     with open(results_path, "w") as fh:
         json.dump(results, fh, indent=2)
         fh.write("\n")

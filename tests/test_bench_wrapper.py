@@ -310,6 +310,60 @@ def test_last_banked_scans_capture_jsonl(tmp_path, monkeypatch):
     assert bench._last_banked("mfu_llama-1b_train", repo=str(tmp_path)) is None
 
 
+def test_mode_flag_guards_reject_foreign_knobs():
+    """Every mode rejects the other modes' knobs (a silently-ignored flag
+    would bank a record indistinguishable from the baseline while the
+    operator believes they measured the override config)."""
+    import pytest
+
+    cases = [
+        # (mode runner, argv, rejected-flag fragment)
+        (bench.run_serving_bench, ["--mode", "serving", "--remat",
+                                   "save_attn"], "--remat"),
+        (bench.run_serving_bench, ["--mode", "serving", "--decode-unroll"],
+         "--decode-unroll"),
+        (bench.run_decode_bench, ["--mode", "decode", "--steps-per-sched",
+                                  "4"], "--steps-per-sched"),
+        (bench.run_decode_bench, ["--mode", "decode", "--optimizer",
+                                  "adafactor"], "--optimizer"),
+        (bench.run_decode_bench, ["--mode", "decode", "--context", "2048"],
+         "--context"),
+        (bench.run_trainer_bench, ["--mode", "trainer", "--cache-layout",
+                                   "stacked"], "--cache-layout"),
+        (bench.run_trainer_bench, ["--mode", "trainer", "--context",
+                                   "2048"], "--context"),
+    ]
+    import re
+
+    for runner, argv, frag in cases:
+        args = bench.parse_args(argv)
+        with pytest.raises(ValueError, match=re.escape(frag)):
+            runner(args)
+
+
+def test_error_result_metric_mirrors_success_series():
+    """A failed run's metric name must match the success series of the
+    SAME invocation (decode layout suffixes, serving suffixes, ctx)."""
+    # Default decode (unstacked default) fails -> _unstacked series.
+    rec = bench.error_result(
+        bench.parse_args(["--mode", "decode"]), "boom", 1)
+    assert rec["metric"] == "decode_tokens_per_sec_gpt2-124m_unstacked"
+    # Explicit stacked -> the historical unsuffixed series.
+    rec = bench.error_result(
+        bench.parse_args(["--mode", "decode", "--cache-layout", "stacked"]),
+        "boom", 1)
+    assert rec["metric"] == "decode_tokens_per_sec_gpt2-124m"
+    # Serving default -> _unstacked.
+    rec = bench.error_result(
+        bench.parse_args(["--mode", "serving"]), "boom", 1)
+    assert rec["metric"] == "serving_tokens_per_sec_gpt2-124m_unstacked"
+    # Train with a context override -> _ctxN series.
+    rec = bench.error_result(
+        bench.parse_args(["--context", "16384",
+                          "--preset", "gpt2-8k-sp"]), "boom", 1)
+    assert rec["metric"] == "mfu_gpt2-8k-sp_train_ctx16384"
+
+
 def test_structured_inner_error_is_relayed(monkeypatch, capsys):
     # Deterministic inner failures relay the inner run's structured record.
     inner = {"metric": "mfu_gpt2-124m_train", "value": 0.0,
