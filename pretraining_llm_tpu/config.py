@@ -125,6 +125,14 @@ class ModelConfig:
     # 2026-08-01 at gpt2-124m b8: unstacked 6,856 tok/s vs stacked 4,129
     # (+66%). Semantics identical (tested: greedy/ragged/int8).
     decode_cache_layout: str = "unstacked"
+    # Unstacked-layout dispatch boundary: multi-token cached forwards with
+    # Tq <= this take the in-place per-layer loop (single-token decode
+    # steps and speculative-decoding verify rounds, where per-call
+    # re-stack copies would claw back the unstacked win); larger Tq
+    # (prefill buckets start at 16) re-stacks once and runs the rolled
+    # scan so the prefill program stays O(1) in depth. Raise it if you
+    # run speculative decoding with spec_k >= this value.
+    decode_loop_max_tokens: int = 8
     # Shard activations' sequence dim over the 'seq' mesh axis (Megatron-SP)
     sequence_parallel: bool = False
     # Sliding-window attention (Mistral-style): each query attends only the
@@ -190,6 +198,11 @@ class ModelConfig:
             raise ValueError(
                 "decode_cache_layout must be 'stacked' or 'unstacked', got "
                 f"{self.decode_cache_layout!r}"
+            )
+        if self.decode_loop_max_tokens < 1:
+            raise ValueError(
+                f"decode_loop_max_tokens must be >= 1, got "
+                f"{self.decode_loop_max_tokens}"
             )
         if self.decode_unroll_layers and self.decode_cache_layout != "stacked":
             # The unroll knob only means something on the stacked depth

@@ -785,12 +785,16 @@ def forward(
         # slice/update-slice relayouts (together ~50% of the profiled v5e
         # decode step). Layer weights come from static slices of the
         # stacked block params (fold into their consumers, no copies).
-        if t > 1:
+        if t > cfg.decode_loop_max_tokens:
             # PREFILL: the carry-copy pathology is per decode STEP; a
             # python layer loop here would only scale the prefill program
             # (and its compile time) by n_layers. Re-stack, run the rolled
             # scan once, unstack the result — two whole-cache copies per
-            # prefill, amortized over the entire generation.
+            # prefill, amortized over the entire generation. Small multi-
+            # token calls (speculative-decoding verify rounds, Tq=k+1)
+            # keep the in-place layer loop below: they repeat every few
+            # tokens, so per-round re-stack copies would claw back the
+            # unstacked layout's win (boundary: decode_loop_max_tokens).
             stacked_cache = {
                 name: jnp.stack([lyr[name] for lyr in kv_cache["layers"]])
                 for name in kv_cache["layers"][0]
