@@ -26,11 +26,13 @@ Stages (priority order):
                      precision (the BASELINE.md promised TPU rerun)
   4. e2e           — train -> SIGTERM -> resume -> evaluate, on chip
   5. sweep-top     — the most promising perf-sweep configs (proven classes)
-  6. batch-sweep / mfu-350m / mfu-1b / sweep2 — batch knee, larger BASELINE
-                     models, second-wave sweep (proven classes)
-  7. decode        — KV-cached decode throughput (+ ragged serving shape)
-  8. ctx8k / trainer — 8k context, trainer-loop overlap
-  9. [risky, gated] profile / profile-decode / decode-int8 / unroll-sweep /
+  6. batch-sweep / mfu-350m / mfu-1b / mfu-1b-ladder / mfu-wave3 /
+     mfu-wave4 / sweep2 — batch knees, the larger BASELINE models'
+                     remat x batch x CE ladders (proven classes)
+  7. decode        — KV-cached decode (+ stacked comparison arm, ragged)
+  8. ctx8k / ctx16k / trainer — 8k + 16k/32k context, trainer overlap
+  9. [risky, gated] profile / profile-decode / decode-int8 /
+                    decode-unroll / unroll-sweep / serving (+sps sweep) /
                     sweep-full
 
 Usage:
