@@ -5,7 +5,9 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 The BASELINE.json target is >=50% MFU on the 124M GPT-2 config;
-`vs_baseline` is measured_MFU / 0.50 (1.0 = target met).
+`vs_baseline` is measured_MFU / 0.50 (1.0 = target met). Metrics with no
+reference baseline at all (decode, serving — the reference publishes
+neither) carry `vs_baseline: null`, never a 0.0 sentinel.
 
 Resilience: the TPU backend here is reached through a tunnel that can return
 transient UNAVAILABLE errors or hang outright during init. JAX caches a failed
@@ -275,7 +277,7 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "metric": f"decode_tokens_per_sec_{args.preset}",
         "value": round(tps, 1),
         "unit": "tokens_per_sec",
-        "vs_baseline": 0.0,  # the reference publishes no decode numbers
+        "vs_baseline": None,  # the reference publishes no decode numbers
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
@@ -373,7 +375,7 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "metric": f"serving_tokens_per_sec_{args.preset}",
         "value": round(n_tok / dt, 1),
         "unit": "generated_tokens_per_sec",
-        "vs_baseline": 0.0,  # the reference has no serving stack
+        "vs_baseline": None,  # the reference has no serving stack
         "max_batch": max_batch,
         "n_requests": n_requests,
         "new_tokens_per_request": new_tokens,
@@ -480,7 +482,7 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
         "metric": f"trainer_tokens_per_sec_{cfg.name}",
         "value": round(tok_per_sec / n_dev, 1),
         "unit": "tokens_per_sec_chip",
-        "vs_baseline": 0.0,
+        "vs_baseline": round(mfu / 0.50, 4),  # same north-star ratio as the mfu record
         "mfu": round(mfu, 4),
         "prefetch": cfg.data.prefetch,
         "batch": batch,
@@ -697,7 +699,9 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         "metric": metric,
         "value": 0.0,
         "unit": unit,
-        "vs_baseline": 0.0,
+        # Same null contract as the success path: decode/serving have no
+        # reference baseline, so their failure records carry null too.
+        "vs_baseline": None if args.mode in ("decode", "serving") else 0.0,
         "error": msg[:800],
         "attempts": attempts,
     }

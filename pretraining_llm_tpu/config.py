@@ -764,6 +764,10 @@ _register(
             qkv_bias=False,
             mlp_bias=True,
             remat="dots_saveable",
+            # Perf intent: flash. Parity experiments pin their own config
+            # (scripts/parity_experiment.py builds it explicitly), so the
+            # preset is free to use the fast kernel.
+            attention_impl="flash",
         ),
         mesh=MeshConfig(data=-1, fsdp=4),
         train=TrainConfig(batch_size=32, train_steps=200_000, lr=1e-4, eval_interval=1000, eval_iters=250),
@@ -805,6 +809,7 @@ _register(
             n_experts=8,
             experts_per_token=2,
             remat="dots_saveable",
+            attention_impl="flash",
         ),
         mesh=MeshConfig(data=-1, expert=4),
         train=TrainConfig(batch_size=32, lr=3e-4),

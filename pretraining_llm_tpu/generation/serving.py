@@ -27,6 +27,7 @@ generate, /root/reference/src/models/transformer.py:96-114).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -137,6 +138,16 @@ class ServingEngine:
 
             tp = mesh.shape.get("tensor", 1)
             head_ax = "tensor" if (tp > 1 and cfg.kv_heads % tp == 0) else None
+            if tp > 1 and head_ax is None:
+                # Same loudness convention as the flash blockwise fallback:
+                # silent replication here multiplies KV HBM by the tensor
+                # axis size on every shard.
+                warnings.warn(
+                    f"serving KV pool: kv_heads={cfg.kv_heads} not divisible "
+                    f"by tensor={tp}; pool REPLICATED over the tensor axis "
+                    f"({tp}x KV HBM per shard). Choose tp dividing kv_heads.",
+                    stacklevel=2,
+                )
             # Every pool leaf carries kv_heads at axis -2 (scale pools have
             # a trailing 1); stacked leaves are 5-dim, unstacked 4-dim.
             self.pools = jax.tree.map(

@@ -1004,7 +1004,18 @@ def _subtract_onehot(p: jax.Array, targets: jax.Array) -> jax.Array:
     data-formatting ops in the 2026-08-01 hlo_stats capture, ~15 ms/step
     of pure relayout at b16). The iota-compare-subtract form fuses into
     the same elementwise pass that builds p: zero extra memory traffic.
+
+    Contract: targets must lie in [0, vocab_size). The scatter form wrapped
+    negative indices (``.at[t].add`` subtracts at column V+t); this form is a
+    NO-OP for out-of-range ids, so the two differ if an ignore-index
+    convention is ever added — route ignored positions through a loss MASK
+    (as loss_fn's docmask path does), never a sentinel target id.
     """
+    if __debug__ and not isinstance(targets, jax.core.Tracer):
+        assert int(targets.min()) >= 0 and int(targets.max()) < p.shape[1], (
+            "_subtract_onehot: targets outside [0, vocab) — use a loss mask, "
+            "not a sentinel id"
+        )
     cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, dimension=1)
     return p - (cols == targets[:, None]).astype(p.dtype)
 

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the axon TPU backend every ~4 minutes; append one status line per
+# probe to data/captures/backend_probe_r05.log. Each probe is a fresh
+# process under a hard timeout (JAX caches a failed backend per-process).
+# Round-5 driver for "pivot to hardware work the moment the chip returns".
+LOG=${1:-/root/repo/data/captures/backend_probe_r05.log}
+INTERVAL=${2:-240}
+mkdir -p "$(dirname "$LOG")"
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 150 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256))
+print('ALIVE', d[0].device_kind, float((x @ x).sum()))
+" 2>&1 | grep -E "ALIVE|Error" | tail -1)
+  RC=$?
+  if [ -z "$OUT" ]; then OUT="DEAD (hang/timeout rc=$RC)"; fi
+  echo "$TS $OUT" >> "$LOG"
+  sleep "$INTERVAL"
+done

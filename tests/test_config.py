@@ -78,3 +78,44 @@ def test_json_roundtrip():
     cfg = get_preset("llama-1b")
     restored = Config.from_json(cfg.to_json())
     assert restored == cfg
+
+
+# Perf-preset intent table. Round 4 found the 350M preset silently running
+# NAIVE attention for every pre-2026-08-01 measurement (only gpt2-124m set
+# attention_impl="flash") — caught by a human reading a profile. This table
+# makes that a class that cannot recur: every preset used for performance
+# work must match its declared attention/remat/CE intent exactly, so a
+# silently-defaulted knob fails CI instead of burning a hardware session.
+# "tiny" is deliberately absent (test-only, perf knobs irrelevant).
+_PERF_INTENT = {
+    #                   attention_impl  remat             ce_impl
+    "gpt2-124m":       ("flash",        "none",           "chunked"),
+    "gpt2-350m-dp":    ("flash",        "none",           "chunked"),
+    "gpt2-1p3b-fsdp":  ("flash",        "dots_saveable",  "chunked"),
+    "llama-1b":        ("flash",        "dots_saveable",  "chunked"),
+    "gpt2-8k-sp":      ("ring",         "save_attn",      "chunked"),
+    "reference-3b":    ("flash",        "dots_saveable",  "chunked"),
+    "llama3-1b-gqa":   ("flash",        "dots_saveable",  "chunked"),
+    "moe-8x350m":      ("flash",        "dots_saveable",  "chunked"),
+}
+
+
+def test_every_perf_preset_has_declared_intent():
+    """Every registered preset is either in the intent table or 'tiny'."""
+    missing = set(list_presets()) - set(_PERF_INTENT) - {"tiny"}
+    assert not missing, (
+        f"presets {sorted(missing)} have no declared perf intent; add them to "
+        "_PERF_INTENT so attention/remat/CE knobs cannot silently default"
+    )
+
+
+@pytest.mark.parametrize("name", sorted(_PERF_INTENT))
+def test_preset_perf_knobs_match_intent(name):
+    attn, remat, ce = _PERF_INTENT[name]
+    m = get_preset(name).model
+    assert m.attention_impl == attn, (
+        f"{name}: attention_impl={m.attention_impl!r}, intent {attn!r} "
+        "(the round-4 350M silent-naive bug class)"
+    )
+    assert m.remat == remat, f"{name}: remat={m.remat!r}, intent {remat!r}"
+    assert m.ce_impl == ce, f"{name}: ce_impl={m.ce_impl!r}, intent {ce!r}"
