@@ -97,7 +97,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--attention", default="", choices=["", "naive", "flash"])
     parser.add_argument("--ce", default="", choices=["", "chunked", "fused", "dense"])
     parser.add_argument(
-        "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
+        "--remat", default="", choices=["", "none", "full", "dots_saveable", "save_attn", "save_attn_res", "save_qkv_attn", "save_big"]
     )
     parser.add_argument("--unroll", type=int, default=0, help="scan_unroll override")
     parser.add_argument(
@@ -143,6 +143,28 @@ def parse_args(argv=None) -> argparse.Namespace:
         type=float,
         default=700.0,
         help="hard wall-clock cap for a single attempt (compile can take minutes on TPU)",
+    )
+    parser.add_argument(
+        "--no-pipeline", action="store_true",
+        help="serving mode: disable the double-buffered scheduler (A/B "
+        "baseline; the pipelined run loop is the default)",
+    )
+    parser.add_argument(
+        "--paged-attn", default="", choices=["", "gather", "kernel"],
+        help="serving mode: paged decode attention impl (kernel = the "
+        "Pallas block-table kernel, gather = XLA pool[tables] assembly)",
+    )
+    parser.add_argument(
+        "--spec-draft", default="", choices=["", "self"],
+        help="serving mode: speculative decoding draft. 'self' uses the "
+        "TARGET as its own draft — acceptance ~100%%, measuring the "
+        "dispatch-amortization UPPER BOUND (no trained draft ships with "
+        "the bench); real deployments pass a trained draft via "
+        "scripts/serve.py --draft_model_path",
+    )
+    parser.add_argument(
+        "--spec-k", type=int, default=4,
+        help="serving mode: draft proposals per speculative round",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -221,7 +243,8 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "--optimizer": args.optimizer, "--unroll": args.unroll,
         "--block-q": args.block_q, "--block-kv": args.block_kv,
         "--steps-per-sched": args.steps_per_sched,
-        "--context": args.context,
+        "--context": args.context, "--paged-attn": args.paged_attn,
+        "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -329,6 +352,8 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
     cfg = get_preset(args.preset).model
     if args.kv_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+    if args.paged_attn:
+        cfg = dataclasses.replace(cfg, paged_attention_impl=args.paged_attn)
     if args.cache_layout:
         # Controls the POOL container too (make_paged_kv_pool honors
         # decode_cache_layout) — 'stacked' reproduces the historical
@@ -357,14 +382,22 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
 
     sps = args.steps_per_sched or 8
 
+    spec = {}
+    if args.spec_draft == "self":
+        spec = dict(draft_params=params, draft_cfg=cfg, spec_k=args.spec_k)
+
     def serve():
         eng = ServingEngine(
             params, cfg, max_batch=max_batch, n_blocks=n_blocks,
-            block_size=block_size, temperature=1.0,
-            steps_per_sched=sps,
+            block_size=block_size,
+            # Spec serving is temperature-only; greedy keeps the self-
+            # draft acceptance at its upper bound. Plain serving keeps
+            # the historical temperature=1.0 series.
+            temperature=0.0 if spec else 1.0,
+            steps_per_sched=sps, **spec,
         )
         rids = [eng.submit(p, new_tokens) for p in prompts]
-        out = eng.run()
+        out = eng.run(pipeline=not args.no_pipeline)
         return sum(len(out[r]) for r in rids), eng.stats
 
     serve()  # compile + warm (prefill buckets + the window program)
@@ -380,6 +413,10 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "n_requests": n_requests,
         "new_tokens_per_request": new_tokens,
         "steps_per_sched": sps,
+        # spec_k forces the synchronous loop (run() ignores pipeline=True):
+        # the record must say what actually ran, not what was requested.
+        "pipeline": (not args.no_pipeline) and not spec,
+        "paged_attention_impl": cfg.paged_attention_impl,
         "block_size": block_size,
         "n_blocks": n_blocks,
         "kv_cache_dtype": cfg.kv_cache_dtype,
@@ -387,6 +424,9 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "wall_s": round(dt, 2),
         "device": jax.devices()[0].device_kind,
     }
+    if spec:
+        rec["metric"] += "_spec"  # self-draft upper-bound series
+        rec["spec_k"] = args.spec_k
     if cfg.kv_cache_dtype == "int8":
         rec["metric"] += "_kvint8"
     if cfg.decode_cache_layout == "unstacked":
@@ -404,7 +444,8 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
             "--decode-unroll": args.decode_unroll,
             "--steps-per-sched": args.steps_per_sched,
             "--cache-layout": args.cache_layout,
-            "--context": args.context}
+            "--context": args.context, "--paged-attn": args.paged_attn,
+            "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the trainer path")
@@ -514,7 +555,9 @@ def run_bench(args: argparse.Namespace) -> dict:
     noop = {"--ragged": args.ragged, "--kv-dtype": args.kv_dtype,
             "--decode-unroll": args.decode_unroll,
             "--steps-per-sched": args.steps_per_sched,
-            "--cache-layout": args.cache_layout}
+            "--cache-layout": args.cache_layout,
+            "--paged-attn": args.paged_attn,
+            "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the train path")
@@ -819,6 +862,12 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd.append("--decode-unroll")
     if args.steps_per_sched:
         cmd += ["--steps-per-sched", str(args.steps_per_sched)]
+    if args.no_pipeline:
+        cmd.append("--no-pipeline")
+    if args.paged_attn:
+        cmd += ["--paged-attn", args.paged_attn]
+    if args.spec_draft:
+        cmd += ["--spec-draft", args.spec_draft, "--spec-k", str(args.spec_k)]
     if args.cache_layout:
         cmd += ["--cache-layout", args.cache_layout]
     if args.context:
@@ -926,6 +975,11 @@ def wrapper_main(args: argparse.Namespace) -> int:
         # cheapest projected step past 41.6%; saved logits at b16 are
         # ~1.65 GB, well within budget on top of save_attn's footprint.
         candidates = [
+            # save_attn_res (r5): saves the flash VJP's (o, lse) outputs so
+            # the kernel never reruns in backward — the r4 profile showed
+            # the flash forward running TWICE under save_attn (same memory
+            # class, +4 bytes/token/head for lse). Newest policy leads.
+            ("save_attn_res", "", 0, "dense", True),
             ("save_attn", "", 0, "dense", True),
             ("save_attn", "", 0, "", True),
             ("none", "", 8, "dense", True),

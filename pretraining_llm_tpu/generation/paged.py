@@ -108,17 +108,17 @@ class BlockAllocator:
             self._free.append(i)
 
 
-@functools.partial(jax.jit, static_argnames=("n_pages",), donate_argnums=(0,))
-def _scatter_pages(
+def _scatter_staged_pages(
     pools: transformer.KVCache,
     dense_cache: transformer.KVCache,
-    block_ids: jax.Array,  # (n_pages,) int32
-    n_pages: int,
+    flat_ids: jax.Array,  # (n_rows * n_pages,) int32 pool block ids
+    n_chunks: int,  # n_rows * n_pages (static)
 ) -> transformer.KVCache:
-    """Scatter a (L, 1, n_pages*bs, ...) dense prefill cache into the pools
-    (stacked or unstacked container) at ``block_ids``. Donated pools: the
-    update is in-place on device."""
-    unstacked = "layers" in pools
+    """ONE definition of the staged-cache -> pool page scatter, shared by
+    the single-prompt and batched admission prefills. The staged cache is
+    STACKED ((L, N, n_pages*bs, ...) fields); each field is cut into
+    ``n_chunks`` pages and scattered at ``flat_ids`` (pad pages point at
+    the reserved scratch block 0 — duplicate indices there are benign)."""
 
     def _fields(layer_pool, dense_layer):
         out = dict(layer_pool)
@@ -127,12 +127,12 @@ def _scatter_pages(
             if dense_key not in dense_cache:
                 continue
             scattered += 1
-            buf = dense_layer(dense_cache[dense_key])  # (pages*bs, ...) or (L, pages*bs, ...)
-            lead = buf.shape[: buf.ndim - 3]  # () unstacked, (L,) stacked
+            buf = dense_layer(dense_cache[dense_key])  # (N, P, ...) or (L, N, P, ...)
+            lead = buf.shape[: buf.ndim - 4]  # () per-layer, (L,) stacked
             tail = buf.shape[-2:]
-            pages = buf.reshape(lead + (n_pages, -1) + tail)
-            idx = (block_ids,) if not lead else (slice(None), block_ids)
-            out[pool_key] = layer_pool[pool_key].at[idx].set(
+            pages = buf.reshape(lead + (n_chunks, -1) + tail)
+            sel = (flat_ids,) if not lead else (slice(None), flat_ids)
+            out[pool_key] = layer_pool[pool_key].at[sel].set(
                 pages.astype(layer_pool[pool_key].dtype)
             )
         if not scattered:
@@ -144,17 +144,28 @@ def _scatter_pages(
             )
         return out
 
-    if unstacked:
+    if "layers" in pools:
         return {
             "layers": tuple(
-                _fields(
-                    pools["layers"][layer],
-                    lambda buf, _l=layer: buf[_l, 0],
-                )
+                _fields(pools["layers"][layer], lambda buf, _l=layer: buf[_l])
                 for layer in range(len(pools["layers"]))
             )
         }
-    return _fields(pools, lambda buf: buf[:, 0])
+    return _fields(pools, lambda buf: buf)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pages",), donate_argnums=(0,))
+def _scatter_pages(
+    pools: transformer.KVCache,
+    dense_cache: transformer.KVCache,
+    block_ids: jax.Array,  # (n_pages,) int32
+    n_pages: int,
+) -> transformer.KVCache:
+    """Scatter a (L, 1, n_pages*bs, ...) dense prefill cache into the pools
+    (stacked or unstacked container) at ``block_ids``. Donated pools: the
+    update is in-place on device. (The batch-1 form of
+    ``_scatter_staged_pages``.)"""
+    return _scatter_staged_pages(pools, dense_cache, block_ids, n_pages)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "p_bucket", "mesh"))
@@ -235,6 +246,135 @@ def prefill_into_pool(
     return last, pools
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "p_bucket", "n_pages", "temperature", "top_k", "top_p",
+        "min_p", "mesh",
+    ),
+    donate_argnums=(1,),
+)
+def _prefill_scatter_sample(
+    params: Any,
+    pools: transformer.KVCache,
+    prompts: jax.Array,  # (N, p_bucket) int32, zero-padded rows
+    prompt_lens: jax.Array,  # (N,) int32 — true lengths (>= 1)
+    block_ids: jax.Array,  # (N, n_pages) int32 — 0 (scratch) for pad pages
+    key: jax.Array,
+    cfg: ModelConfig,
+    p_bucket: int,
+    n_pages: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """Batched admission in ONE device program: causal prefill over N
+    padded prompts -> scatter every row's pages into the pools -> sample
+    each row's first token. The per-request admission path paid one
+    prefill program + one scatter + one host-synced sample PER request —
+    N arrivals in a scheduling window cost N serialized tunnel round
+    trips, the dominant term in the measured 8x serving/decode gap. Here
+    N admissions are one dispatch and at most one sync (the engine defers
+    even that in pipelined mode).
+
+    Pad pages (rows shorter than the bucket) scatter to the reserved
+    scratch block 0; duplicate scatter indices there are benign by the
+    pool's scratch discipline. Pad ROWS (N rounded up to a bucket) carry
+    all-zero tables and garbage tokens the caller slices away.
+    """
+    import dataclasses as _dc
+
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    n_rows = prompts.shape[0]
+    with activation_mesh(mesh):
+        # Stacked staging cache regardless of the decode default — the
+        # scatter consumes (L, N, pages*bs, ...) field layouts.
+        cache = transformer.make_kv_cache(
+            _dc.replace(cfg, decode_cache_layout="stacked"), n_rows, p_bucket
+        )
+        logits, cache = transformer.forward(
+            params, prompts, cfg, kv_cache=cache, cache_index=jnp.int32(0)
+        )
+        idx = jnp.clip(prompt_lens - 1, 0, p_bucket - 1).astype(jnp.int32)
+        last = jnp.take_along_axis(
+            logits,
+            jnp.broadcast_to(idx[:, None, None], (n_rows, 1, logits.shape[-1])),
+            axis=1,
+        )[:, 0]
+        toks = sample_logits(
+            last, key, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p,
+        ).astype(jnp.int32)
+
+        pools = _scatter_staged_pages(
+            pools, cache, block_ids.reshape(-1), n_rows * n_pages
+        )
+        return toks, pools
+
+
+def prefill_into_pool_batched(
+    params: Any,
+    cfg: ModelConfig,
+    pools: transformer.KVCache,
+    prompts: Sequence[Sequence[int]],
+    rows_block_ids: Sequence[Sequence[int]],
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """Prefill N prompts and write all their pages into the pool in one
+    device program; returns (first sampled token per prompt — a DEVICE
+    (N,) int32 array, no host sync — and the updated pools).
+
+    ``rows_block_ids[i]`` must be exactly ceil(len(prompts[i])/block_size)
+    pages. Rows and pages are bucketed to powers of two so the jit cache
+    stays at O(log(max_batch) * log(max_pages)) program variants.
+    """
+    if "layers" in pools:
+        block_size = int(pools["layers"][0]["k_pool"].shape[1])
+    else:
+        block_size = int(pools["k_pool"].shape[2])
+    n = len(prompts)
+    if n == 0:
+        raise ValueError("no prompts")
+    pages = []
+    for i, (p, ids) in enumerate(zip(prompts, rows_block_ids)):
+        if len(p) == 0:
+            raise ValueError("empty prompt")
+        np_i = required_blocks(len(p), block_size)
+        if np_i != len(ids):
+            raise ValueError(
+                f"prompt {i} of {len(p)} tokens needs exactly {np_i} pages; "
+                f"got {len(ids)} block ids"
+            )
+        pages.append(np_i)
+    import numpy as np
+
+    bucket_rows = 1 << (n - 1).bit_length()
+    bucket_pages = 1 << (max(pages) - 1).bit_length()
+    p_bucket = bucket_pages * block_size
+    prompt_arr = np.zeros((bucket_rows, p_bucket), np.int32)
+    lens = np.ones((bucket_rows,), np.int32)
+    ids_arr = np.zeros((bucket_rows, bucket_pages), np.int32)
+    for i, (p, ids) in enumerate(zip(prompts, rows_block_ids)):
+        prompt_arr[i, : len(p)] = p
+        lens[i] = len(p)
+        ids_arr[i, : len(ids)] = ids
+    toks, pools = _prefill_scatter_sample(
+        params, pools, jnp.asarray(prompt_arr), jnp.asarray(lens),
+        jnp.asarray(ids_arr), key, cfg, p_bucket, bucket_pages,
+        temperature, top_k, top_p, min_p, mesh,
+    )
+    return toks[:n], pools
+
+
 def _forward_sample_one(
     params, pools, tokens, block_tables, seq_lens, key, cfg,
     temperature, top_k, top_p, min_p, mesh=None,
@@ -292,6 +432,139 @@ def paged_decode_step(
         params, pools, tokens, block_tables, seq_lens, key, cfg,
         temperature, top_k, top_p, min_p, mesh,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg_t", "cfg_d", "k", "temperature", "mesh"),
+    donate_argnums=(1, 2),
+)
+def paged_spec_round(
+    params_t: Any,
+    t_pools: transformer.KVCache,
+    d_pools: transformer.KVCache,
+    params_d: Any,
+    tokens: jax.Array,  # (B,) int32 — each row's newest accepted token
+    block_tables: jax.Array,  # (B, max_blocks) int32 — SHARED by both pools
+    seq_lens: jax.Array,  # (B,) int32
+    key: jax.Array,
+    cfg_t: ModelConfig,
+    cfg_d: ModelConfig,
+    k: int,
+    temperature: float = 0.0,
+    mesh: Any = None,
+) -> Tuple[jax.Array, jax.Array, transformer.KVCache, transformer.KVCache]:
+    """One speculative round for every batch row over the paged pools:
+    k single-token DRAFT steps propose, then the target VERIFIES all k in
+    one (k+1)-token multi-token paged forward (models/transformer.py's
+    tq>1 paged branch). Returns (emit (B, k+1), n_emit (B,), t_pools,
+    d_pools): row b's valid output is emit[b, :n_emit[b]], between 1 and
+    k+1 tokens (the accepted prefix + the target's correction/bonus).
+
+    Both pools share ONE block table and frontier: page p of a request
+    holds target K/V in the target pool and draft K/V in the draft pool
+    (the allocator hands out ids once — the draft cache needs no second
+    bookkeeping). Rejected slots hold garbage above the new frontier and
+    are overwritten by the next round's writes, the same slot-reuse
+    discipline as the contiguous speculative path
+    (generation/speculative.py).
+
+    Greedy (temperature=0) output equals target-only paged decoding row
+    for row; sampling uses the Leviathan accept/reject rule vectorized
+    over rows.
+    """
+    from pretraining_llm_tpu.generation.speculative import _probs
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    b = tokens.shape[0]
+    v = cfg_t.vocab_size
+
+    with activation_mesh(mesh):
+        # --- draft: k proposal steps (no extra write-only step needed —
+        # paged writes land at seq+j each step, and the verify below
+        # covers the same slots in the draft's NEXT round implicitly
+        # because slot reuse overwrites garbage).
+        def draft_step(carry, j):
+            d_pools, tok, key = carry
+            key, sub = jax.random.split(key)
+            logits, d_pools = transformer.forward(
+                params_d, tok[:, None], cfg_d, kv_cache=d_pools,
+                paged=transformer.PagedInfo(block_tables, seq_lens + j),
+            )
+            q_dist = jax.vmap(lambda l: _probs(l, temperature))(
+                logits[:, 0]
+            )  # (B, V)
+            if temperature == 0.0:
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(
+                    sub, logits[:, 0].astype(jnp.float32) / temperature
+                ).astype(jnp.int32)
+            return (d_pools, nxt, key), (nxt, q_dist)
+
+        (d_pools, d_last, key), (drafts, q_dists) = jax.lax.scan(
+            draft_step, (d_pools, tokens, key), jnp.arange(k)
+        )
+        drafts = drafts.T  # (B, k)
+        q_dists = jnp.moveaxis(q_dists, 0, 1)  # (B, k, V)
+
+        # Write-only parking step (same as the contiguous path): the k-th
+        # proposal's K/V must reach slot seq+k, or an all-accept round
+        # leaves the next round's draft attending a stale slot — output
+        # stays correct either way (acceptance always verifies against
+        # the target), but the draft's hit rate would silently degrade.
+        _, d_pools = transformer.forward(
+            params_d, d_last[:, None], cfg_d, kv_cache=d_pools,
+            paged=transformer.PagedInfo(block_tables, seq_lens + k),
+        )
+
+        # --- target: verify last + k drafts in ONE multi-token forward
+        seq_tokens = jnp.concatenate(
+            [tokens[:, None], drafts], axis=1
+        )  # (B, k+1)
+        t_logits, t_pools = transformer.forward(
+            params_t, seq_tokens, cfg_t, kv_cache=t_pools,
+            paged=transformer.PagedInfo(block_tables, seq_lens),
+        )  # (B, k+1, V)
+        p_dists = jax.vmap(
+            jax.vmap(lambda l: _probs(l, temperature))
+        )(t_logits)  # (B, k+1, V)
+
+        # --- accept / reject (vectorized over rows) -------------------
+        key, sub_u, sub_r = jax.random.split(key, 3)
+        rows = jnp.arange(b)[:, None]
+        cols = jnp.arange(k)[None, :]
+        p_at = p_dists[rows, cols, drafts]  # (B, k)
+        q_at = q_dists[rows, cols, drafts]
+        if temperature == 0.0:
+            accepts = p_at > 0.0
+        else:
+            u = jax.random.uniform(sub_u, (b, k))
+            accepts = u < jnp.minimum(1.0, p_at / jnp.maximum(q_at, 1e-30))
+        n_acc = jnp.sum(
+            jnp.cumprod(accepts.astype(jnp.int32), axis=1), axis=1
+        ).astype(jnp.int32)  # (B,)
+
+        p_final = p_dists[jnp.arange(b), n_acc]  # (B, V)
+        if temperature == 0.0:
+            final = jnp.argmax(p_final, axis=-1).astype(jnp.int32)
+        else:
+            q_pad = jnp.concatenate(
+                [q_dists, jnp.zeros((b, 1, v), jnp.float32)], axis=1
+            )
+            resid = jnp.maximum(p_final - q_pad[jnp.arange(b), n_acc], 0.0)
+            resid = resid / jnp.maximum(
+                jnp.sum(resid, axis=-1, keepdims=True), 1e-30
+            )
+            final = jax.random.categorical(
+                sub_r, jnp.log(resid + 1e-30)
+            ).astype(jnp.int32)
+
+        emit = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )  # (B, k+1)
+        emit = emit.at[jnp.arange(b), n_acc].set(final)
+        return emit, n_acc + 1, t_pools, d_pools
 
 
 @functools.partial(

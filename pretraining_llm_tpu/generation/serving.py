@@ -37,7 +37,6 @@ import numpy as np
 
 from pretraining_llm_tpu.config import ModelConfig
 from pretraining_llm_tpu.generation import paged
-from pretraining_llm_tpu.generation.sampling import sample_logits
 from pretraining_llm_tpu.models import transformer
 
 
@@ -55,6 +54,17 @@ class _Request:
     row: Optional[int] = None
     admit_order: int = -1  # monotonically increasing per admission
     preemptions: int = 0
+    # Pipelined admission: the first sampled token stays ON DEVICE as
+    # (batch_array, index) until the window it joined is reaped — the
+    # engine never syncs just to learn it (see _resolve_first).
+    pending_first: Optional[tuple] = None
+
+    @property
+    def n_generated(self) -> int:
+        """Generated count INCLUDING a not-yet-materialized first token —
+        the value scheduling math (max_new countdown, page horizons) must
+        use so deferred resolution never changes allocation decisions."""
+        return len(self.generated) + (1 if self.pending_first is not None else 0)
 
 
 class ServingEngine:
@@ -89,6 +99,9 @@ class ServingEngine:
         seed: int = 0,
         steps_per_sched: int = 1,
         mesh: Any = None,
+        draft_params: Any = None,
+        draft_cfg: Optional[ModelConfig] = None,
+        spec_k: int = 0,
     ):
         if cfg.n_experts:
             # Same restriction as ragged generate: pad slots inside a
@@ -98,6 +111,36 @@ class ServingEngine:
             # Decode sessions are single documents; forward() rejects the
             # combination with a cache (same sanitization as generate()).
             cfg = dataclasses.replace(cfg, doc_mask_token=-1)
+        # Speculative serving: a draft model proposes spec_k tokens per
+        # round, the target verifies them in ONE multi-token paged
+        # forward (paged.paged_spec_round). Greedy output equals
+        # target-only serving; decode dispatches drop ~(k+1)x at the
+        # draft's acceptance rate.
+        if (spec_k > 0) != (draft_params is not None and draft_cfg is not None):
+            raise ValueError(
+                "speculative serving needs all three of draft_params, "
+                "draft_cfg and spec_k >= 1 (or none of them)"
+            )
+        self.spec_k = int(spec_k)
+        self.draft_params = draft_params
+        self.draft_cfg: Optional[ModelConfig] = None
+        if spec_k:
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft_cfg.vocab_size}) must equal "
+                    f"target vocab ({cfg.vocab_size})"
+                )
+            if draft_cfg.n_experts:
+                raise ValueError("draft model cannot be MoE (same rule)")
+            if top_k or top_p or min_p:
+                raise ValueError(
+                    "speculative serving supports temperature-only "
+                    "sampling (the accept/reject rule needs the raw "
+                    "draft/target distributions)"
+                )
+            if draft_cfg.doc_mask_token >= 0:
+                draft_cfg = dataclasses.replace(draft_cfg, doc_mask_token=-1)
+            self.draft_cfg = draft_cfg
         self.params = params
         self.cfg = cfg
         self.max_batch = int(max_batch)
@@ -132,25 +175,33 @@ class ServingEngine:
         # its own heads' pages — the same head split as training TP), and
         # decode activations follow via the in-forward constraints.
         self.mesh = mesh
-        self.pools = transformer.make_paged_kv_pool(cfg, n_blocks, block_size)
-        if mesh is not None:
+
+        def _build_pool(pool_cfg: ModelConfig):
+            pools = transformer.make_paged_kv_pool(
+                pool_cfg, n_blocks, block_size
+            )
+            if mesh is None:
+                return pools
             from jax.sharding import NamedSharding, PartitionSpec
 
             tp = mesh.shape.get("tensor", 1)
-            head_ax = "tensor" if (tp > 1 and cfg.kv_heads % tp == 0) else None
+            head_ax = (
+                "tensor" if (tp > 1 and pool_cfg.kv_heads % tp == 0) else None
+            )
             if tp > 1 and head_ax is None:
                 # Same loudness convention as the flash blockwise fallback:
                 # silent replication here multiplies KV HBM by the tensor
                 # axis size on every shard.
                 warnings.warn(
-                    f"serving KV pool: kv_heads={cfg.kv_heads} not divisible "
-                    f"by tensor={tp}; pool REPLICATED over the tensor axis "
-                    f"({tp}x KV HBM per shard). Choose tp dividing kv_heads.",
+                    f"serving KV pool: kv_heads={pool_cfg.kv_heads} not "
+                    f"divisible by tensor={tp}; pool REPLICATED over the "
+                    f"tensor axis ({tp}x KV HBM per shard). Choose tp "
+                    f"dividing kv_heads.",
                     stacklevel=2,
                 )
             # Every pool leaf carries kv_heads at axis -2 (scale pools have
             # a trailing 1); stacked leaves are 5-dim, unstacked 4-dim.
-            self.pools = jax.tree.map(
+            return jax.tree.map(
                 lambda leaf: jax.device_put(
                     leaf,
                     NamedSharding(
@@ -160,8 +211,14 @@ class ServingEngine:
                         ),
                     ),
                 ),
-                self.pools,
+                pools,
             )
+
+        self.pools = _build_pool(cfg)
+        # Draft pools mirror the block structure exactly: SAME table/ids,
+        # draft-model dims per block (paged_spec_round's shared-frontier
+        # contract).
+        self.d_pools = _build_pool(self.draft_cfg) if self.spec_k else None
         self.alloc = paged.BlockAllocator(n_blocks)
         self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
         self.seq_lens = np.zeros((self.max_batch,), np.int32)
@@ -172,6 +229,11 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
+        # Pipelined scheduling state: the in-flight window (tokens still
+        # on device) and admission token merges queued for the next
+        # dispatch — see _run_pipelined.
+        self._inflight: Optional[tuple] = None
+        self._pending_admit_merges: List[tuple] = []
         self.stats = {"steps": 0, "tokens": 0, "preemptions": 0, "admissions": 0}
 
     # -- public API --------------------------------------------------------
@@ -209,10 +271,14 @@ class ServingEngine:
 
     def step(self) -> None:
         """One scheduling round: admit -> grow/preempt -> a window of
-        ``steps_per_sched`` lockstep decode steps -> reap. A no-op when
-        nothing is running or waiting."""
+        ``steps_per_sched`` lockstep decode steps (or ONE speculative
+        round when spec_k is set) -> reap. A no-op when nothing is
+        running or waiting."""
         self._admit()
         if self.n_active == 0:
+            return
+        if self.spec_k:
+            self._spec_step()
             return
         n = self.steps_per_sched
         self._ensure_write_pages(horizon=n)
@@ -254,21 +320,197 @@ class ServingEngine:
                     self._finish(req)
                     break  # surplus window tokens for this row are discarded
 
-    def run(self) -> Dict[int, List[int]]:
-        """Drive step() until every submitted request has finished."""
-        while self.has_work():
-            self.step()
+    def _spec_step(self) -> None:
+        """One speculative round for every active row: k draft proposals,
+        one multi-token target verify, per-row ragged acceptance (1 to
+        k+1 tokens emitted per row). The round writes slots
+        [seq, seq + k] in BOTH pools, so the page horizon is spec_k + 1;
+        rejected slots hold garbage above each row's new frontier and are
+        overwritten by the next round (slot-reuse discipline)."""
+        k = self.spec_k
+        self._ensure_write_pages(horizon=k + 1)
+        if self.n_active == 0:  # everyone got preempted (tiny pool)
+            return
+        paged.check_paged_bounds(self.tables, self.seq_lens, self.block_size)
+        self._key, sub = jax.random.split(self._key)
+        emit, n_emit, self.pools, self.d_pools = paged.paged_spec_round(
+            self.params, self.pools, self.d_pools, self.draft_params,
+            jnp.asarray(self.tokens), jnp.asarray(self.tables),
+            jnp.asarray(self.seq_lens), sub, cfg_t=self.cfg,
+            cfg_d=self.draft_cfg, k=k, temperature=self.temperature,
+            mesh=self.mesh,
+        )
+        emit = np.asarray(emit)  # (B, k+1)
+        n_emit = np.asarray(n_emit)  # (B,)
+        self.stats["steps"] += 1
+        self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) + 1
+        self.stats["spec_proposed"] = (
+            self.stats.get("spec_proposed", 0) + k * self.n_active
+        )
+        for row, req in enumerate(self.rows):
+            if req is None:
+                continue
+            self.stats["spec_accepted"] = (
+                self.stats.get("spec_accepted", 0) + int(n_emit[row]) - 1
+            )
+            for tok in (int(t) for t in emit[row, : int(n_emit[row])]):
+                self.seq_lens[row] += 1  # this round wrote the slot
+                req.generated.append(tok)
+                self.tokens[row] = tok
+                self.stats["tokens"] += 1
+                if tok == self.stop_token or len(req.generated) >= req.max_new:
+                    self._finish(req)
+                    break  # surplus accepted tokens are discarded
+
+    def run(self, *, pipeline: bool = True) -> Dict[int, List[int]]:
+        """Drive the engine until every submitted request has finished.
+
+        ``pipeline=True`` (default) runs the double-buffered scheduler:
+        window k+1 is DISPATCHED before window k's results are read back,
+        so the host's reap/admit work and the readback round trip overlap
+        the device's execution instead of idling it — the device only
+        drains when admission needs pool space held by unreaped rows.
+        The price is one window of lag on finish detection (a finished
+        row decodes one surplus window before its slot frees; surplus
+        tokens were already discarded by design). Greedy outputs are
+        IDENTICAL to pipeline=False; with temperature > 0 the sampling
+        key stream differs (window keys split in dispatch order).
+
+        Speculative serving (spec_k > 0) always runs the synchronous
+        loop: each round's page horizon depends on the previous round's
+        data-dependent acceptance, so windows cannot be dispatched ahead
+        of their reap. (Spec already amortizes dispatch ~(k+1)x per
+        accepted run — the lever pipelining provides for plain decode.)
+        """
+        if not pipeline or self.spec_k:
+            while self.has_work():
+                self.step()
+            return self.finished
+        return self._run_pipelined()
+
+    def _run_pipelined(self) -> Dict[int, List[int]]:
+        assert self._inflight is None, "re-entrant run()"
+        while self.has_work() or self._inflight is not None:
+            self._admit(defer=True)
+            if self.n_active:
+                self._ensure_write_pages(horizon=self.steps_per_sched)
+            prev = self._inflight
+            if self.n_active:
+                self._inflight = self._dispatch_window()
+            else:
+                self._inflight = None
+            if prev is not None:
+                # Blocks until window k-1 is done — while window k (just
+                # dispatched) executes behind it on the device stream.
+                self._reap_window(prev)
         return self.finished
+
+    def _dispatch_window(self) -> tuple:
+        """Enqueue one ``steps_per_sched``-step decode window WITHOUT
+        waiting for the previous one: input tokens come from the previous
+        window's last column (still on device) merged with admission
+        first-tokens (also on device); seq_lens advance host-side by the
+        window length (every active row writes exactly that many slots,
+        finished-or-not — surplus is discarded at reap)."""
+        n = self.steps_per_sched
+        capacity = self.max_blocks * self.block_size
+        # Clamp: a finished-but-unreaped row may have written up to its
+        # full allocation; feeding seq == capacity would trip the bounds
+        # guard (and the model would clamp its page index onto a live
+        # block). capacity-1 keeps its garbage writes inside its OWN last
+        # block until it is reaped.
+        seq_dispatch = np.minimum(self.seq_lens, capacity - 1)
+        active = [i for i, r in enumerate(self.rows) if r is not None]
+        paged.check_paged_bounds(
+            self.tables[active], seq_dispatch[active], self.block_size
+        )
+        if self._inflight is not None:
+            base = self._inflight[0][:, -1]  # (B,) device, no sync
+        else:
+            base = jnp.asarray(self.tokens)
+        for toks_dev, idxs, rows in self._pending_admit_merges:
+            base = base.at[jnp.asarray(rows, jnp.int32)].set(
+                toks_dev[jnp.asarray(idxs, jnp.int32)]
+            )
+        self._pending_admit_merges = []
+        self._key, sub = jax.random.split(self._key)
+        toks, self.pools = paged.paged_decode_steps(
+            self.params, self.pools, base, jnp.asarray(self.tables),
+            jnp.asarray(seq_dispatch), sub, cfg=self.cfg, n_steps=n,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+        )
+        self.stats["steps"] += n
+        snapshot = [(i, self.rows[i]) for i in active]
+        for i in active:
+            self.seq_lens[i] = min(int(self.seq_lens[i]) + n, capacity)
+        return (toks, snapshot, n)
+
+    def _reap_window(self, inflight: tuple) -> None:
+        """Materialize a window's tokens and do the lagged bookkeeping:
+        resolve deferred first tokens, extend outputs, finish rows that
+        hit stop/max_new (their surplus in-window tokens are discarded,
+        exactly as in the synchronous path)."""
+        toks_dev, snapshot, n = inflight
+        window = np.asarray(toks_dev)  # (B, n) — THE sync point
+        for row, req in snapshot:
+            if req.row != row or self.rows[row] is not req:
+                # The row finished in an earlier reap and may have been
+                # re-admitted since; this window's tokens for it are
+                # surplus garbage by the lag contract. (Preemption can't
+                # land here: it flushes the inflight window first.)
+                continue
+            self._resolve_first(req)
+            if req.row is None:  # first token alone finished it
+                continue
+            for tok in (int(t) for t in window[row]):
+                req.generated.append(tok)
+                self.tokens[row] = tok
+                self.stats["tokens"] += 1
+                if tok == self.stop_token or len(req.generated) >= req.max_new:
+                    self._finish(req)
+                    break  # surplus window tokens for this row are discarded
+
+    def _flush_inflight(self) -> None:
+        """Synchronously drain the in-flight window (pipelined mode) so
+        host state is exact — required before preemption decisions."""
+        if self._inflight is not None:
+            prev, self._inflight = self._inflight, None
+            self._reap_window(prev)
+
+    def _resolve_first(self, req: _Request) -> None:
+        """Materialize a deferred admission token (device is done with it
+        by the time any caller needs the value)."""
+        if req.pending_first is None:
+            return
+        arr, i = req.pending_first
+        req.pending_first = None
+        tok = int(np.asarray(arr)[i])
+        req.generated.append(tok)
+        if req.row is not None:
+            self.tokens[req.row] = tok
+            if tok == self.stop_token or len(req.generated) >= req.max_new:
+                self._finish(req)
 
     # -- scheduling internals ---------------------------------------------
 
-    def _admit(self) -> None:
-        """FCFS admission: the head of the queue claims a free row when the
-        pool covers its prompt pages + the first decode write."""
+    def _admit(self, defer: bool = False) -> None:
+        """FCFS admission: every queue head that fits claims a free row,
+        then ALL claimed prompts prefill in ONE device program (batched
+        admission — N arrivals used to pay N serialized prefill programs
+        + N host-synced first-token samples, the dominant term of the
+        measured 8x serving/decode gap at the window boundary).
+
+        ``defer=True`` (pipelined run loop) keeps the sampled first
+        tokens on device: bookkeeping that needs their VALUES (stop
+        tokens, output lists) lags until the window they join is reaped,
+        while scheduling math uses ``n_generated`` which already counts
+        them."""
+        admits: List[_Request] = []
         while self.waiting:
             free_rows = [i for i, r in enumerate(self.rows) if r is None]
             if not free_rows:
-                return
+                break
             req: _Request = self.waiting[0]
             p = len(req.prompt)
             # +1: the first decode step writes slot p — its page must exist.
@@ -280,35 +522,57 @@ class ServingEngine:
             # boundary (prefill thrash). The stalled head waits for active
             # rows to finish and free blocks; preemption happens on growth.
             if self.alloc.available - need < self.n_active:
-                return
+                break
             blocks = self.alloc.alloc(need)
             assert blocks is not None, "watermark guarantees coverage"
             self.waiting.popleft()
             row = free_rows[0]
-            prefill_pages = paged.required_blocks(p, self.block_size)
-            last, self.pools = paged.prefill_into_pool(
-                self.params, self.cfg, self.pools, req.prompt,
-                blocks[:prefill_pages], mesh=self.mesh,
-            )
-            self._key, sub = jax.random.split(self._key)
-            tok = int(
-                sample_logits(
-                    last[None], sub, temperature=self.temperature,
-                    top_k=self.top_k, top_p=self.top_p, min_p=self.min_p,
-                )[0]
-            )
             req.blocks = blocks
             req.row = row
             req.admit_order = self._admit_counter
             self._admit_counter += 1
             self.stats["admissions"] += 1
-            req.generated.append(tok)
-            self.stats["tokens"] += 1  # the prefill-sampled first token
-            self.rows[row] = req
+            self.rows[row] = req  # claim now: n_active sees earlier admits
             self.tables[row, :] = 0
             self.tables[row, : len(blocks)] = blocks
             self.seq_lens[row] = p
-            self.tokens[row] = tok
+            admits.append(req)
+        if not admits:
+            return
+        self._key, sub = jax.random.split(self._key)
+        prompts = [r.prompt for r in admits]
+        prefill_ids = [
+            r.blocks[: paged.required_blocks(len(r.prompt), self.block_size)]
+            for r in admits
+        ]
+        toks_dev, self.pools = paged.prefill_into_pool_batched(
+            self.params, self.cfg, self.pools, prompts, prefill_ids,
+            sub, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+        )
+        if self.spec_k:
+            # The draft cache must cover the same pages (its sampled
+            # tokens are discarded — the target's first token above is
+            # the round seed either way).
+            _, self.d_pools = paged.prefill_into_pool_batched(
+                self.draft_params, self.draft_cfg, self.d_pools, prompts,
+                prefill_ids, sub, temperature=self.temperature,
+                mesh=self.mesh,
+            )
+        self.stats["tokens"] += len(admits)  # the prefill-sampled firsts
+        if defer:
+            rows = [r.row for r in admits]
+            for i, req in enumerate(admits):
+                req.pending_first = (toks_dev, i)
+            # Next dispatch merges these device scalars into its input
+            # tokens without a host round trip.
+            self._pending_admit_merges.append((toks_dev, list(range(len(admits))), rows))
+            return
+        toks = np.asarray(toks_dev)
+        for i, req in enumerate(admits):
+            tok = int(toks[i])
+            req.generated.append(tok)
+            self.tokens[req.row] = tok
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
 
@@ -326,7 +590,11 @@ class ServingEngine:
             req = self.rows[row]
             if req is None:
                 continue
-            remaining = req.max_new - len(req.generated)
+            # n_generated may lag the device by one in-flight window
+            # (pipelined mode): remaining is then an OVERestimate, so the
+            # horizon only ever covers extra slots — writes stay inside
+            # allocated (or scratch-redirected) pages either way.
+            remaining = req.max_new - req.n_generated
             last_write = min(
                 int(self.seq_lens[row]) + min(horizon, remaining) - 1,
                 capacity - 1,
@@ -338,12 +606,20 @@ class ServingEngine:
                     req.blocks.extend(got)
                     self.tables[row, len(req.blocks) - 1] = got[0]
                     continue
+                if self._inflight is not None:
+                    # Pool dry with a window in flight: drain it first —
+                    # its finished rows may free blocks, and preemption
+                    # bookkeeping (prompt+generated) must be exact.
+                    self._flush_inflight()
+                    if self.rows[row] is not req:
+                        break  # this row finished in the flush
+                    continue  # retry allocation against the fresh state
                 victim = max(
                     (r for r in self.rows if r is not None),
                     key=lambda r: r.admit_order,
                 )
                 self._preempt(victim)
-                if victim is req:
+                if victim is req or self.rows[row] is not req:
                     break  # this row is gone; nothing more to grow
 
     def _preempt(self, req: _Request) -> None:
@@ -351,8 +627,15 @@ class ServingEngine:
         FRONT with prompt+generated as the new prompt (vLLM-style recompute
         recovery — cheap for short generations, and the only option that
         frees ALL its blocks)."""
+        # A victim admitted this very boundary may still hold its first
+        # token on device; resolve it so the resumed prompt is exact.
+        # Resolution can itself FINISH the request (stop token /
+        # max_new=1) — then its blocks are already freed and there is
+        # nothing to preempt.
+        self._resolve_first(req)
+        if req.row is None:
+            return
         row = req.row
-        assert row is not None
         self.stats["preemptions"] += 1
         new_prompt = req.prompt + req.generated
         remaining = req.max_new - len(req.generated)

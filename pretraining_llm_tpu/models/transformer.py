@@ -234,9 +234,12 @@ def _attention_block(
     if rope is not None:
         cos, sin = rope
         if paged is not None:
-            # Paged decode: row i's query IS the token at logical position
-            # seq_lens[i] (linear index within its own block list).
-            rope_pos = paged.seq_lens[:, None]
+            # Paged decode: row i's j-th query token sits at logical
+            # position seq_lens[i] + j (linear index within its own block
+            # list; j > 0 only in the speculative verify).
+            rope_pos = paged.seq_lens[:, None] + jnp.arange(
+                k.shape[1], dtype=paged.seq_lens.dtype
+            )[None, :]
         elif pad_offsets is not None:
             # Per-row logical positions: slot - left-pad offset. Pad slots
             # clip to 0; their K/V is masked out of every real attention.
@@ -280,14 +283,14 @@ def _attention_block(
             raise ValueError(
                 "a paged kv pool requires forward(..., paged=PagedInfo)"
             )
-        if k.shape[1] != 1:
-            raise ValueError(
-                "the in-forward paged path is single-token decode only; "
-                "prompts enter the pool via generation.paged.prefill_into_pool"
-            )
         bsz = q.shape[0]
+        tq = k.shape[1]
         block_size = kv["k_pool"].shape[1]
         tables, seq = paged.block_tables, paged.seq_lens
+        # Token i of this call writes logical slot seq + i. tq == 1 is the
+        # serving decode step; tq > 1 is the speculative-decoding paged
+        # VERIFY (k+1 draft tokens through the target in one program —
+        # prompts still enter via generation.paged.prefill_into_pool).
         # Multi-step scheduling overshoot guard: inside a fixed-length
         # decode window a row can pass its capacity (it gets reaped right
         # after); redirect such writes to the reserved scratch block
@@ -295,23 +298,25 @@ def _attention_block(
         # block and corrupt a live slot. Single-step schedulers never hit
         # this (check_paged_bounds), multi-step ones hit it by design.
         capacity = tables.shape[1] * block_size
-        in_range = seq < capacity
-        seq_c = jnp.minimum(seq, capacity - 1)
+        pos = seq[:, None] + jnp.arange(tq, dtype=seq.dtype)[None, :]  # (B,T)
+        in_range = pos < capacity
+        pos_c = jnp.minimum(pos, capacity - 1)
         blk_ids = jnp.where(
-            in_range, tables[jnp.arange(bsz), seq_c // block_size], 0
-        )  # (B,)
-        slots = jnp.where(in_range, seq_c % block_size, 0)  # (B,)
+            in_range, tables[jnp.arange(bsz)[:, None], pos_c // block_size], 0
+        )  # (B, T)
+        slots = jnp.where(in_range, pos_c % block_size, 0)  # (B, T)
         quantized = "k_scale_pool" in kv
 
         def scatter(pool, val):
-            # One (B,)-row scatter per pool: rows own disjoint blocks, so
-            # indices collide only between idle rows parked on the reserved
-            # scratch block — whose content is never unmasked.
+            # One (B, T)-indexed scatter per pool: rows own disjoint
+            # blocks and a row's T slots are distinct, so indices collide
+            # only on the reserved scratch block (idle rows, overshoot
+            # redirects) — whose content is never unmasked.
             return pool.at[blk_ids, slots].set(val.astype(pool.dtype))
 
         if quantized:
-            k_q, k_sc = _kv_quantize(k[:, 0])
-            v_q, v_sc = _kv_quantize(v[:, 0])
+            k_q, k_sc = _kv_quantize(k)
+            v_q, v_sc = _kv_quantize(v)
             new_kv = {
                 "k_pool": scatter(kv["k_pool"], k_q),
                 "v_pool": scatter(kv["v_pool"], v_q),
@@ -320,40 +325,59 @@ def _attention_block(
             }
         else:
             new_kv = {
-                "k_pool": scatter(kv["k_pool"], k[:, 0]),
-                "v_pool": scatter(kv["v_pool"], v[:, 0]),
+                "k_pool": scatter(kv["k_pool"], k),
+                "v_pool": scatter(kv["v_pool"], v),
             }
 
-        max_blocks = tables.shape[1]
-        kv_len = max_blocks * block_size
-
-        def gather(pool):
-            # (B, max_blocks, block_size, ...) -> (B, kv_len, ...): each
-            # row's logical KV sequence, assembled from its pool blocks.
-            return pool[tables].reshape((bsz, kv_len) + pool.shape[2:])
-
-        if quantized:
-            ck = _kv_dequantize(
-                gather(new_kv["k_pool"]), gather(new_kv["k_scale_pool"]), cdt
+        if cfg.paged_attention_impl == "kernel" and not quantized and tq == 1:
+            # Gather-free: the Pallas kernel DMAs each row's pages straight
+            # off the pool via the block table (ops/pallas_paged.py) — the
+            # row's KV bytes are read once, no (B, kv_len) copy is ever
+            # materialized. (int8 pools keep the gather below: validation
+            # rejects the combination at config time.)
+            from pretraining_llm_tpu.ops.pallas_paged import (
+                paged_decode_attention,
             )
-            cv = _kv_dequantize(
-                gather(new_kv["v_pool"]), gather(new_kv["v_scale_pool"]), cdt
-            )
+
+            out = paged_decode_attention(
+                q[:, 0].astype(cdt),
+                new_kv["k_pool"].astype(cdt),
+                new_kv["v_pool"].astype(cdt),
+                tables, seq, window=cfg.sliding_window,
+            )[:, None]
         else:
-            ck = gather(new_kv["k_pool"]).astype(cdt)
-            cv = gather(new_kv["v_pool"]).astype(cdt)
-        lin = jnp.arange(kv_len)
-        # Causality is the length mask: slot seq (this token) and everything
-        # before it. Unallocated table tail entries point at arbitrary
-        # blocks but sit at linear indices > seq — always masked.
-        kv_mask = lin[None, :] <= seq[:, None]
-        if cfg.sliding_window:
-            kv_mask = kv_mask & (
-                lin[None, :] > seq[:, None] - cfg.sliding_window
+            max_blocks = tables.shape[1]
+            kv_len = max_blocks * block_size
+
+            def gather(pool):
+                # (B, max_blocks, block_size, ...) -> (B, kv_len, ...): each
+                # row's logical KV sequence, assembled from its pool blocks.
+                return pool[tables].reshape((bsz, kv_len) + pool.shape[2:])
+
+            if quantized:
+                ck = _kv_dequantize(
+                    gather(new_kv["k_pool"]), gather(new_kv["k_scale_pool"]), cdt
+                )
+                cv = _kv_dequantize(
+                    gather(new_kv["v_pool"]), gather(new_kv["v_scale_pool"]), cdt
+                )
+            else:
+                ck = gather(new_kv["k_pool"]).astype(cdt)
+                cv = gather(new_kv["v_pool"]).astype(cdt)
+            lin = jnp.arange(kv_len)
+            # Causality is the length mask, per query token: token i (at
+            # logical slot seq+i) sees slots <= seq+i — its own just-
+            # written K/V and everything before it. Unallocated table tail
+            # entries point at arbitrary blocks but sit at linear indices
+            # beyond the frontier — always masked.
+            kv_mask = lin[None, None, :] <= pos[:, :, None]  # (B, T, kv_len)
+            if cfg.sliding_window:
+                kv_mask = kv_mask & (
+                    lin[None, None, :] > pos[:, :, None] - cfg.sliding_window
+                )
+            out = multihead_attention(
+                q, ck, cv, impl="naive", causal=False, kv_mask=kv_mask
             )
-        out = multihead_attention(
-            q, ck, cv, impl="naive", causal=False, kv_mask=kv_mask
-        )
     elif kv is not None:
         # Decode: write this step's K/V into the cache at cache_index, attend
         # over the whole (masked) cache. The cache is a per-layer dict
@@ -662,11 +686,9 @@ def forward(
                 "paged=PagedInfo requires a pool-layout kv_cache "
                 "(make_paged_kv_pool)"
             )
-        if t != 1:
-            raise ValueError(
-                "paged decode is single-token; prompts enter the pool via "
-                "generation.paged.prefill_into_pool"
-            )
+        # t == 1: serving decode; small t > 1: speculative paged verify.
+        # PROMPTS still enter via generation.paged.prefill_into_pool —
+        # the in-forward path scatters tokens one slot past the frontier.
         if pad_offsets is not None:
             raise ValueError(
                 "pad_offsets is the contiguous ragged layout; paged rows "
@@ -709,8 +731,15 @@ def forward(
     if cfg.pos_embed == "learned":
         pos_table = constrain(params["pos_embed"]["embedding"], None, None)
         if paged is not None:
-            # Each row's single query sits at its own logical position.
-            x = x + pos_table[paged.seq_lens][:, None].astype(cdt)
+            # Each row's query tokens sit at their own logical positions
+            # (seq + i); clip keeps overshoot rows (scratch-redirected
+            # garbage by contract) inside the table.
+            ppos = jnp.clip(
+                paged.seq_lens[:, None]
+                + jnp.arange(t, dtype=paged.seq_lens.dtype)[None, :],
+                0, cfg.context_length - 1,
+            )
+            x = x + pos_table[ppos].astype(cdt)
         elif pad_offsets is not None:
             logical = jnp.clip(positions[None, :] - pad_offsets[:, None], 0)
             x = x + pos_table[logical].astype(cdt)  # (B, T, D) per-row gather

@@ -69,7 +69,12 @@ def naive_attention(
         w_ok = (q_positions[:, None] - kv_positions[None, :]) < window
         scores = jnp.where(w_ok[None, None, None, :, :], scores, -jnp.inf)
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, None, :], scores, -jnp.inf)
+        # (B, Tk) masks unwritten cache slots uniformly; (B, Tq, Tk)
+        # additionally varies by query — the multi-token paged verify's
+        # per-row causal frontier (each of the Tq speculative tokens sees
+        # a different prefix of its row's pool blocks).
+        kv_mask_q = kv_mask if kv_mask.ndim == 3 else kv_mask[:, None, :]
+        scores = jnp.where(kv_mask_q[:, None, None, :, :], scores, -jnp.inf)
     if segments is not None:
         if tq != tk:
             raise ValueError("segments requires self-attention (Tq == Tk)")
@@ -84,9 +89,9 @@ def naive_attention(
         # layers' 0-weight attention to the dead slot contributes 0*NaN =
         # NaN, poisoning every real slot in the batch row.
         if causal:
-            valid = causal_mask[None, :, :] & kv_mask[:, None, :]  # (B,Tq,Tk)
+            valid = causal_mask[None, :, :] & kv_mask_q  # (B,Tq,Tk)
         else:
-            valid = jnp.broadcast_to(kv_mask[:, None, :], (b, tq, tk))
+            valid = jnp.broadcast_to(kv_mask_q, (b, tq, tk))
         dead = ~valid.any(axis=-1)  # (B, Tq)
         probs = jnp.where(dead[:, None, None, :, None], 0.0, probs)
     out = jnp.einsum(

@@ -10,6 +10,15 @@ Policies (cheapest memory -> cheapest recompute):
   - "dots_saveable": save every matmul output (XLA default-ish middle ground).
   - "save_attn":     save only the merged attention output ("attn_out" tag);
                      backward re-runs QKV projection + the flash forward.
+  - "save_attn_res": save the flash kernel's OUTPUT residuals ("attn_o_res",
+                     "attn_lse") instead: the attention VJP starts from its
+                     saved (o, lse) — the flash forward never reruns — while
+                     the QKV projection (plain matmuls the VJP needs as
+                     inputs anyway) still recomputes. Same memory class as
+                     save_attn (+lse, 4 bytes/token/head); kills the double
+                     flash-forward the 2026-08-01 profile showed under
+                     save_attn. (Distinct from the LOSING save_qkv_attn,
+                     which additionally saved the q/k/v INPUTS.)
   - "save_qkv_attn": additionally save post-RoPE q/k/v ("qkv") and the flash
                      VJP residuals ("attn_o_res", "attn_lse") — the attention
                      backward starts directly from its residuals, so neither
@@ -30,10 +39,12 @@ import jax
 # tag sites — a policy naming a tag that no longer exists silently saves
 # nothing for it.
 _SAVE_ATTN = ("attn_out",)
-_SAVE_QKV_ATTN = ("qkv", "attn_o_res", "attn_lse")
+_SAVE_ATTN_RES = ("attn_o_res", "attn_lse")
+_SAVE_QKV_ATTN = ("qkv",) + _SAVE_ATTN_RES
 _SAVE_BIG = _SAVE_QKV_ATTN + ("mlp_hidden",)
 
-POLICIES = ("none", "full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big")
+POLICIES = ("none", "full", "dots_saveable", "save_attn", "save_attn_res",
+            "save_qkv_attn", "save_big")
 
 
 def checkpoint_wrap(fn: Callable, remat: str) -> Callable:
@@ -47,6 +58,11 @@ def checkpoint_wrap(fn: Callable, remat: str) -> Callable:
     if remat == "save_attn":
         return jax.checkpoint(
             fn, policy=jax.checkpoint_policies.save_only_these_names(*_SAVE_ATTN)
+        )
+    if remat == "save_attn_res":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.save_only_these_names(*_SAVE_ATTN_RES),
         )
     if remat == "save_qkv_attn":
         return jax.checkpoint(

@@ -13,9 +13,10 @@ import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((256, 256))
 print('ALIVE', d[0].device_kind, float((x @ x).sum()))
-" 2>&1 | grep -E "ALIVE|Error" | tail -1)
-  RC=$?
-  if [ -z "$OUT" ]; then OUT="DEAD (hang/timeout rc=$RC)"; fi
-  echo "$TS $OUT" >> "$LOG"
+" 2>&1)
+  RC=$?  # timeout's status: 124 = hang-killed, else python's own exit
+  LINE=$(printf '%s\n' "$OUT" | grep -E "ALIVE|Error" | tail -1)
+  if [ -z "$LINE" ]; then LINE="DEAD (hang/timeout rc=$RC)"; fi
+  echo "$TS $LINE" >> "$LOG"
   sleep "$INTERVAL"
 done

@@ -27,7 +27,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The grid: remat policies x CE head x batch. Attention stays flash (naive
 # is only a reference point; measured 25% vs 41% MFU).
 GRID = {
-    "remat": ["none", "save_attn", "save_qkv_attn", "save_big", "full"],
+    "remat": ["none", "save_attn", "save_attn_res", "save_qkv_attn",
+              "save_big", "full"],
     "ce": ["chunked", "fused", "dense"],
     "batch": [8, 12, 16, 24, 32],
 }

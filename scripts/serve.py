@@ -54,6 +54,14 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--ema", action="store_true",
                         help="serve from the EMA shadow params")
+    parser.add_argument("--draft_model_path", default="",
+                        help="draft checkpoint for SPECULATIVE serving "
+                        "(k proposals per round verified in one target "
+                        "forward; temperature-only sampling)")
+    parser.add_argument("--spec_k", type=int, default=4,
+                        help="draft proposals per speculative round")
+    parser.add_argument("--no-pipeline", action="store_true",
+                        help="disable the double-buffered scheduler")
     parser.add_argument("--tokenizer", default=None,
                         help="override the checkpoint's tokenizer name")
     parser.add_argument("--output", default="",
@@ -75,13 +83,21 @@ def main() -> None:
     params = cast_params_for_inference(params, cfg.model)
     enc = get_tokenizer(args.tokenizer or cfg.data.tokenizer_name)
 
+    spec = {}
+    if args.draft_model_path:
+        d_params, d_cfg = load_model_for_inference(args.draft_model_path)
+        spec = dict(
+            draft_params=cast_params_for_inference(d_params, d_cfg.model),
+            draft_cfg=d_cfg.model, spec_k=args.spec_k,
+        )
+
     eng = ServingEngine(
         params, cfg.model,
         max_batch=args.max_batch, n_blocks=args.n_blocks,
         block_size=args.block_size, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
         stop_token=args.stop_token, seed=args.seed,
-        steps_per_sched=args.steps_per_sched,
+        steps_per_sched=args.steps_per_sched, **spec,
     )
     rids = {}
     rejected = []
@@ -96,7 +112,7 @@ def main() -> None:
         raise SystemExit("every prompt was rejected")
 
     t0 = time.perf_counter()
-    out = eng.run()
+    out = eng.run(pipeline=not args.no_pipeline)
     dt = time.perf_counter() - t0
 
     sink = open(args.output, "w") if args.output else sys.stdout

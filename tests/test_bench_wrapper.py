@@ -91,18 +91,18 @@ def test_hang_with_live_canary_moves_to_next_candidate(monkeypatch, capsys):
     # the problem; candidate 2 succeeds and is reported.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.41, "save_attn"), _ok(0.39, "none"),
-                        _ok(0.38, "none")],
+        attempts_script=[HUNG, _ok(0.41, "save_attn"), _ok(0.39, "save_attn"),
+                        _ok(0.38, "none"), _ok(0.37, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none", "none"]
-    # Rungs reach the inner run at THEIR batch and CE head (the dense-CE
-    # save_attn headline first, then chunked; none rungs likewise).
-    assert calls["batches"] == [0, 0, 8, 8]
-    assert calls["ces"] == ["dense", "", "dense", ""]
+        "save_attn_res", "save_attn", "save_attn", "none", "none"]
+    # Rungs reach the inner run at THEIR batch and CE head (the r5
+    # save_attn_res+dense rung leads, then the save_attn pair, then none).
+    assert calls["batches"] == [0, 0, 0, 8, 8]
+    assert calls["ces"] == ["dense", "dense", "", "dense", ""]
     assert calls["canaries"] == 1  # exactly one cheap probe after the hang
 
 
@@ -130,15 +130,17 @@ def test_wedged_then_recovered_retries_same_candidate(monkeypatch, capsys):
     # min(attempt_timeout, share), so share > 2*attempt_timeout + polls.)
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, _ok(0.40, "save_attn"), _ok(0.38, "save_attn"),
-                        _ok(0.37, "none"), _ok(0.36, "none")],
+        attempts_script=[HUNG, _ok(0.40, "save_attn_res"),
+                        _ok(0.38, "save_attn"), _ok(0.37, "save_attn"),
+                        _ok(0.36, "none"), _ok(0.35, "none")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
-        args=_wrapper_args(timeout_budget=2600, attempt_timeout=150),
+        args=_wrapper_args(timeout_budget=4200, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.40  # best of the race, from the retried candidate
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "save_attn", "none", "none"]
+        "save_attn_res", "save_attn_res", "save_attn", "save_attn",
+        "none", "none"]
 
 
 def test_double_hang_abandons_candidate(monkeypatch, capsys):
@@ -147,15 +149,17 @@ def test_double_hang_abandons_candidate(monkeypatch, capsys):
     # time.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[HUNG, HUNG, _ok(0.39, "save_attn"), _ok(0.37, "none"),
+        attempts_script=[HUNG, HUNG, _ok(0.39, "save_attn"),
+                        _ok(0.38, "save_attn"), _ok(0.37, "none"),
                         _ok(0.36, "none")],
         canary_script=[(False, "dead"), (True, {"ok": True})],
-        args=_wrapper_args(timeout_budget=2600, attempt_timeout=150),
+        args=_wrapper_args(timeout_budget=4200, attempt_timeout=150),
     )
     assert rc == 0
     assert rec["value"] == 0.39
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "save_attn", "none", "none"]
+        "save_attn_res", "save_attn_res", "save_attn", "save_attn",
+        "none", "none"]
 
 
 def test_wedge_with_banked_result_reports_it_immediately(monkeypatch, capsys):
@@ -178,18 +182,20 @@ def test_race_reports_best_of_successes(monkeypatch, capsys):
     # tail is never run (budget preserved).
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.41, "save_attn"), _ok(0.40, "save_attn"),
-                        _ok(0.30, "none"), _ok(0.28, "none")],
+        attempts_script=[_ok(0.41, "save_attn_res"), _ok(0.40, "save_attn"),
+                        _ok(0.39, "save_attn"), _ok(0.30, "none"),
+                        _ok(0.28, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none", "none"]
-    assert calls["batches"] == [0, 0, 8, 8]
+        "save_attn_res", "save_attn", "save_attn", "none", "none"]
+    assert calls["batches"] == [0, 0, 0, 8, 8]
     # Every successful rung's measurement is banked on the winner (r4):
     # losing contenders' values must not vanish from the campaign log.
-    assert [r["value"] for r in rec["rungs"]] == [0.41, 0.40, 0.30, 0.28]
+    assert [r["value"] for r in rec["rungs"]] == [
+        0.41, 0.40, 0.39, 0.30, 0.28]
 
 
 def test_explicit_batch_drops_override_rungs(monkeypatch, capsys):
@@ -200,15 +206,17 @@ def test_explicit_batch_drops_override_rungs(monkeypatch, capsys):
     # not burn hardware window that cannot improve the number.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.40, "save_attn"), _ok(0.39, "save_attn")],
+        attempts_script=[_ok(0.40, "save_attn_res"), _ok(0.39, "save_attn"),
+                        _ok(0.38, "save_attn")],
         canary_script=[(True, {"ok": True})],
         args=_wrapper_args(batch=24),
     )
     assert rc == 0
     assert rec["value"] == 0.40
-    assert [r for r, _ in calls["attempts"]] == ["save_attn", "save_attn"]
-    assert calls["batches"] == [0, 0]  # no per-candidate override in play
-    assert calls["ces"] == ["dense", ""]  # ce rungs keep racing at --batch
+    assert [r for r, _ in calls["attempts"]] == [
+        "save_attn_res", "save_attn", "save_attn"]
+    assert calls["batches"] == [0, 0, 0]  # no per-candidate override in play
+    assert calls["ces"] == ["dense", "dense", ""]  # ce rungs race at --batch
 
 
 def test_matching_explicit_batch_keeps_override_rung(monkeypatch, capsys):
@@ -216,15 +224,16 @@ def test_matching_explicit_batch_keeps_override_rung(monkeypatch, capsys):
     # banked none@8 race win is reproducible at its explicit batch.
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[_ok(0.40, "save_attn"), _ok(0.39, "save_attn"),
-                        _ok(0.52, "none"), _ok(0.50, "none")],
+        attempts_script=[_ok(0.40, "save_attn_res"), _ok(0.39, "save_attn"),
+                        _ok(0.38, "save_attn"), _ok(0.52, "none"),
+                        _ok(0.50, "none")],
         canary_script=[(True, {"ok": True})],
         args=_wrapper_args(batch=8),
     )
     assert rc == 0
     assert rec["value"] == 0.52
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none", "none"]
+        "save_attn_res", "save_attn", "save_attn", "none", "none"]
 
 
 def test_explicit_ce_drops_override_rungs(monkeypatch, capsys):
@@ -251,15 +260,15 @@ def test_oom_is_deterministic_not_transient(monkeypatch, capsys):
                  "while trying to allocate 18.3GiB")
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[oom, _ok(0.41, "save_attn"), _ok(0.40, "none"),
-                        _ok(0.39, "none")],
+        attempts_script=[oom, _ok(0.41, "save_attn"), _ok(0.40, "save_attn"),
+                        _ok(0.39, "none"), _ok(0.38, "none")],
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 0
     assert rec["value"] == 0.41
     # Exactly ONE attempt on the OOM-ing candidate, no backoff retries.
     assert [r for r, _ in calls["attempts"]] == [
-        "save_attn", "save_attn", "none", "none"]
+        "save_attn_res", "save_attn", "save_attn", "none", "none"]
 
 
 def test_environment_error_carries_last_banked(monkeypatch, capsys):
@@ -371,7 +380,7 @@ def test_structured_inner_error_is_relayed(monkeypatch, capsys):
              "error": "RuntimeError: boom", "attempts": 1}
     rc, rec, calls = _run(
         monkeypatch, capsys,
-        attempts_script=[(inner, "rc=1: RuntimeError")] * 7,
+        attempts_script=[(inner, "rc=1: RuntimeError")] * 8,
         canary_script=[(True, {"ok": True})],
     )
     assert rc == 1

@@ -94,6 +94,7 @@ _PERF_INTENT = {
     "gpt2-1p3b-fsdp":  ("flash",        "dots_saveable",  "chunked"),
     "llama-1b":        ("flash",        "dots_saveable",  "chunked"),
     "gpt2-8k-sp":      ("ring",         "save_attn",      "chunked"),
+    "gpt2-8k-gqa":     ("ring",         "save_attn",      "chunked"),
     "reference-3b":    ("flash",        "dots_saveable",  "chunked"),
     "llama3-1b-gqa":   ("flash",        "dots_saveable",  "chunked"),
     "moe-8x350m":      ("flash",        "dots_saveable",  "chunked"),

@@ -170,7 +170,8 @@ def test_swiglu_rmsnorm_rope_variant_runs():
 
 
 @pytest.mark.parametrize(
-    "policy", ["full", "dots_saveable", "save_attn", "save_qkv_attn", "save_big"]
+    "policy", ["full", "dots_saveable", "save_attn", "save_attn_res",
+               "save_qkv_attn", "save_big"]
 )
 def test_remat_matches_no_remat(policy):
     """Every remat policy is a pure scheduling choice: identical gradients.

@@ -80,11 +80,6 @@ def test_forward_paged_validation(params):
     info = transformer.PagedInfo(
         jnp.zeros((2, 8), jnp.int32), jnp.zeros((2,), jnp.int32)
     )
-    with pytest.raises(ValueError, match="single-token"):
-        transformer.forward(
-            params, jnp.zeros((2, 3), jnp.int32), CFG, kv_cache=pools,
-            paged=info,
-        )
     dense = transformer.make_kv_cache(CFG, 2, 16, dtype="float32")
     with pytest.raises(ValueError, match="pool-layout"):
         transformer.forward(params, tok, CFG, kv_cache=dense, paged=info)
@@ -296,6 +291,284 @@ def test_engine_sharded_matches_single_device(params, mesh8):
     out = eng.run()
     for rid, p in zip(rids, prompts):
         assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_multitoken_paged_forward_matches_stepwise(params):
+    """The multi-token paged forward (speculative verify) must produce,
+    position by position, the same logits as T sequential single-token
+    paged steps from the same pool state — and leave the pools in the
+    same state."""
+    rng = np.random.default_rng(3)
+    prompts = _prompts(2)
+    toks = [rng.integers(0, CFG.vocab_size, size=4).tolist() for _ in range(2)]
+    bs = 8
+
+    def build():
+        pools = transformer.make_paged_kv_pool(CFG, 16, bs, dtype="float32")
+        alloc = paged.BlockAllocator(16)
+        tables = np.zeros((2, 4), np.int32)
+        seq = np.zeros((2,), np.int32)
+        for i, p in enumerate(prompts):
+            need = paged.required_blocks(len(p) + 5, bs)
+            ids = alloc.alloc(need)
+            _, pools = paged.prefill_into_pool(
+                params, CFG, pools, p, ids[: paged.required_blocks(len(p), bs)]
+            )
+            tables[i, : len(ids)] = ids
+            seq[i] = len(p)
+        return pools, tables, seq
+
+    # A: one T=4 multi-token paged forward
+    pools_a, tables, seq = build()
+    tok_arr = jnp.asarray(np.stack([np.asarray(t) for t in toks]), jnp.int32)
+    info = transformer.PagedInfo(jnp.asarray(tables), jnp.asarray(seq))
+    logits_a, pools_a = transformer.forward(
+        params, tok_arr, CFG, kv_cache=pools_a, paged=info
+    )
+    # B: 4 sequential single-token steps
+    pools_b, tables_b, seq_b = build()
+    logits_b = []
+    for j in range(4):
+        info_j = transformer.PagedInfo(
+            jnp.asarray(tables_b), jnp.asarray(seq_b + j)
+        )
+        lj, pools_b = transformer.forward(
+            params, tok_arr[:, j : j + 1], CFG, kv_cache=pools_b, paged=info_j
+        )
+        logits_b.append(np.asarray(lj[:, 0]))
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.stack(logits_b, axis=1), atol=2e-4
+    )
+    for leaf_a, leaf_b in zip(
+        jax.tree.leaves(pools_a), jax.tree.leaves(pools_b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_a), np.asarray(leaf_b), atol=1e-5
+        )
+
+
+def test_batched_prefill_matches_sequential(params):
+    """One fused prefill program for N prompts == N sequential prefills:
+    same pool bytes on every real block (both layouts), same greedy
+    first tokens."""
+    prompts = _prompts(3)
+    for layout in ("unstacked", "stacked"):
+        cfg = dataclasses.replace(CFG, decode_cache_layout=layout)
+        pools_a = transformer.make_paged_kv_pool(cfg, 16, 8, dtype="float32")
+        pools_b = jax.tree.map(jnp.copy, pools_a)
+        alloc = paged.BlockAllocator(16)
+        ids = [alloc.alloc(paged.required_blocks(len(p), 8)) for p in prompts]
+        lasts = []
+        for p, b in zip(prompts, ids):
+            last, pools_a = paged.prefill_into_pool(params, cfg, pools_a, p, b)
+            lasts.append(int(np.argmax(np.asarray(last))))
+        toks, pools_b = paged.prefill_into_pool_batched(
+            params, cfg, pools_b, prompts, ids, jax.random.key(3),
+            temperature=0.0,
+        )
+        assert np.asarray(toks).tolist() == lasts
+
+        def k_block(pools, blk):
+            if "layers" in pools:
+                return np.stack(
+                    [np.asarray(l["k_pool"][blk]) for l in pools["layers"]]
+                )
+            return np.asarray(pools["k_pool"][:, blk])
+
+        for blk in sorted(set(b for row in ids for b in row)):
+            np.testing.assert_allclose(
+                k_block(pools_a, blk), k_block(pools_b, blk), atol=1e-6
+            )
+
+
+def test_batched_prefill_validation(params):
+    pools = transformer.make_paged_kv_pool(CFG, 8, 8, dtype="float32")
+    with pytest.raises(ValueError, match="no prompts"):
+        paged.prefill_into_pool_batched(
+            params, CFG, pools, [], [], jax.random.key(0)
+        )
+    with pytest.raises(ValueError, match="exactly"):
+        paged.prefill_into_pool_batched(
+            params, CFG, pools, [[1, 2, 3]], [[1, 2]], jax.random.key(0)
+        )
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_engine_pipeline_modes_match_generate(params, pipeline):
+    """run(pipeline=...) must emit identical greedy outputs in both the
+    synchronous and the double-buffered scheduler, through a gauntlet of
+    more-requests-than-rows, mid-window finishes, and stop tokens."""
+    prompts = _prompts(6)
+    n_new = 9  # not a multiple of the window: mid-window finishes
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=24, block_size=8,
+        temperature=0.0, steps_per_sched=4,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run(pipeline=pipeline)
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_engine_pipeline_preemption_match(params, pipeline):
+    """Tiny pool forcing preemption: the pipelined scheduler must flush
+    its in-flight window before evicting, so recompute-on-resume resumes
+    from the exact generated prefix in both modes."""
+    prompts = [_prompts(1, lengths=(12,))[0], _prompts(1, lengths=(10,))[0]]
+    n_new = 24
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=8, block_size=8,
+        temperature=0.0, steps_per_sched=4,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run(pipeline=pipeline)
+    assert eng.stats["preemptions"] >= 1
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_engine_pipelined_max_new_one(params):
+    """max_new=1 requests finish on their deferred admission token alone;
+    the row must free and be reusable without a dispatched window."""
+    prompts = _prompts(3)
+    eng = ServingEngine(
+        params, CFG, max_batch=1, n_blocks=16, block_size=8,
+        temperature=0.0, steps_per_sched=4,
+    )
+    rids = [eng.submit(p, 1) for p in prompts]
+    out = eng.run(pipeline=True)
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, 1)
+
+
+def test_paged_kernel_engine_matches_generate(params):
+    """paged_attention_impl='kernel' (Pallas block-table kernel, interpret
+    mode on CPU) must emit the same greedy tokens as the gather path's
+    ground truth — through fragmentation, mid-window finishes, and block
+    reuse."""
+    cfgk = dataclasses.replace(CFG, paged_attention_impl="kernel")
+    prompts = _prompts(4)
+    n_new = 8
+    eng = ServingEngine(
+        params, cfgk, max_batch=2, n_blocks=24, block_size=8,
+        temperature=0.0, steps_per_sched=4,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_paged_kernel_gqa_and_window(params):
+    """Kernel path with GQA heads + sliding window == gather path, token
+    for token."""
+    from pretraining_llm_tpu.models.transformer import init_params
+
+    cfg_g = dataclasses.replace(
+        CFG, n_heads=4, n_kv_heads=2, sliding_window=16
+    )
+    params_g = init_params(cfg_g, jax.random.key(1))
+    cfg_k = dataclasses.replace(cfg_g, paged_attention_impl="kernel")
+    p = _prompts(1, lengths=(20,))[0]
+    n_new = 10
+    out = {}
+    for name, cfg in (("gather", cfg_g), ("kernel", cfg_k)):
+        eng = ServingEngine(
+            params_g, cfg, max_batch=1, n_blocks=16, block_size=8,
+            temperature=0.0,
+        )
+        rid = eng.submit(p, n_new)
+        out[name] = eng.run()[rid]
+    assert out["kernel"] == out["gather"]
+
+
+def test_paged_kernel_config_validation():
+    from pretraining_llm_tpu.config import ModelConfig
+
+    with pytest.raises(ValueError, match="gather' or 'kernel"):
+        ModelConfig(paged_attention_impl="magic")
+    with pytest.raises(ValueError, match="int8"):
+        ModelConfig(paged_attention_impl="kernel", kv_cache_dtype="int8")
+
+
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1, d_model=16, n_heads=2)
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return transformer.init_params(DRAFT_CFG, jax.random.key(99))
+
+
+def test_spec_serving_matches_generate(params, draft_params):
+    """Speculative serving greedy output == dense-cache target-only greedy
+    for ANY draft (here an untrained 1-layer model with a low hit rate):
+    acceptance always verifies against the target argmax."""
+    prompts = _prompts(4)
+    n_new = 10
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=32, block_size=8,
+        temperature=0.0, draft_params=draft_params, draft_cfg=DRAFT_CFG,
+        spec_k=3,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    assert eng.stats["spec_rounds"] > 0
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_spec_serving_self_draft_accepts_everything(params):
+    """Target-as-draft: fp32 greedy acceptance must be ~total, so each
+    round emits k+1 tokens (the degenerate upper bound pins the
+    accept/emit plumbing)."""
+    p = _prompts(1)[0]
+    n_new = 9
+    eng = ServingEngine(
+        params, CFG, max_batch=1, n_blocks=32, block_size=8,
+        temperature=0.0, draft_params=params, draft_cfg=CFG, spec_k=2,
+    )
+    rid = eng.submit(p, n_new)
+    out = eng.run()
+    assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+    st = eng.stats
+    assert st["spec_accepted"] == st["spec_proposed"], st
+
+
+def test_spec_serving_preemption_and_stop(params, draft_params):
+    """Spec serving through a pool small enough to force preemption, plus
+    a stop token that lands mid-round: recompute-on-resume and surplus
+    discard must both hold."""
+    prompts = [_prompts(1, lengths=(12,))[0], _prompts(1, lengths=(10,))[0]]
+    n_new = 16
+    ref0 = _reference_greedy(params, CFG, prompts[0], n_new)
+    stop = ref0[5]
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=8, block_size=8,
+        temperature=0.0, stop_token=stop, draft_params=draft_params,
+        draft_cfg=DRAFT_CFG, spec_k=3,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(rids, prompts):
+        ref = _reference_greedy(params, CFG, p, n_new)
+        want = ref[: ref.index(stop)] if stop in ref else ref
+        assert out[rid] == want, f"request {rid}"
+
+
+def test_spec_serving_validation(params, draft_params):
+    with pytest.raises(ValueError, match="all three"):
+        ServingEngine(params, CFG, spec_k=2)
+    with pytest.raises(ValueError, match="all three"):
+        ServingEngine(params, CFG, draft_params=draft_params,
+                      draft_cfg=DRAFT_CFG)
+    bad = dataclasses.replace(DRAFT_CFG, vocab_size=128)
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(params, CFG, draft_params=draft_params,
+                      draft_cfg=bad, spec_k=2)
+    with pytest.raises(ValueError, match="temperature-only"):
+        ServingEngine(params, CFG, draft_params=draft_params,
+                      draft_cfg=DRAFT_CFG, spec_k=2, top_k=5)
 
 
 def test_engine_interleaved_submission(params):

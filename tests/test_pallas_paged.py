@@ -1,0 +1,110 @@
+"""Pallas paged-attention kernel vs the gather+masked-softmax reference.
+
+The kernel (ops/pallas_paged.py) reads pool pages directly through the
+scalar-prefetched block table; the reference materializes pool[tables]
+and runs a masked softmax — the two must agree to accumulation-order
+tolerance for every (GQA, window, dtype, fragmentation) combination.
+Interpret mode on CPU (same convention as test_pallas_flash).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.ops.pallas_paged import paged_decode_attention
+
+
+def _random_state(rng, b, n_blocks, max_blocks, bs):
+    """Fragmented tables: each row owns a random disjoint set of pages."""
+    perm = rng.permutation(np.arange(1, n_blocks)).tolist()
+    tables = np.zeros((b, max_blocks), np.int32)
+    seq = np.zeros((b,), np.int32)
+    for i in range(b):
+        n_pages = int(rng.integers(1, max_blocks + 1))
+        own = [perm.pop() for _ in range(n_pages)]
+        tables[i, : len(own)] = own
+        seq[i] = int(rng.integers(0, n_pages * bs))
+    return tables, seq
+
+
+def _gather_ref(q, kp, vp, tables, seq, window):
+    b, h, d = q.shape
+    g = kp.shape[2]
+    n_rep = h // g
+    kv_len = tables.shape[1] * kp.shape[1]
+    ck = jnp.repeat(kp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
+    cv = jnp.repeat(vp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
+    lin = jnp.arange(kv_len)
+    mask = lin[None, :] <= seq[:, None]
+    if window:
+        mask = mask & (lin[None, :] > seq[:, None] - window)
+    s = jnp.einsum(
+        "bhd,bkhd->bhk", q.astype(jnp.float32), ck.astype(jnp.float32)
+    ) / np.sqrt(d)
+    s = jnp.where(mask[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", p, cv.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("g,window", [(8, 0), (2, 0), (4, 12), (1, 0)])
+def test_kernel_matches_gather(g, window):
+    rng = np.random.default_rng(g * 100 + window)
+    b, h, d, bs, n_blocks, max_blocks = 3, 8, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq = _random_state(rng, b, n_blocks, max_blocks, bs)
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), window=window
+    )
+    ref = _gather_ref(q, kp, vp, tables, seq, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_kernel_bf16():
+    rng = np.random.default_rng(7)
+    b, h, g, d, bs, n_blocks, max_blocks = 2, 4, 2, 64, 8, 12, 3
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.bfloat16)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.bfloat16)
+    tables, seq = _random_state(rng, b, n_blocks, max_blocks, bs)
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq)
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = _gather_ref(q, kp, vp, tables, seq, 0)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_kernel_seq_zero_and_full():
+    """Edge rows: seq 0 (only the just-written slot visible) and a row at
+    its last slot."""
+    rng = np.random.default_rng(11)
+    b, h, g, d, bs, max_blocks = 2, 4, 4, 64, 8, 2
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(8, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(8, bs, g, d)), jnp.float32)
+    tables = np.asarray([[3, 0], [5, 6]], np.int32)
+    seq = np.asarray([0, 2 * bs - 1], np.int32)
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq)
+    )
+    ref = _gather_ref(q, kp, vp, tables, seq, 0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_kernel_validation():
+    q = jnp.zeros((2, 4, 64))
+    kp = jnp.zeros((8, 8, 3, 64))
+    with pytest.raises(ValueError, match="divide"):
+        paged_decode_attention(
+            q, kp, kp, jnp.zeros((2, 2), jnp.int32), jnp.zeros((2,), jnp.int32)
+        )
+    kp = jnp.zeros((8, 8, 2, 64))
+    with pytest.raises(ValueError, match="batch"):
+        paged_decode_attention(
+            q, kp, kp, jnp.zeros((3, 2), jnp.int32), jnp.zeros((3,), jnp.int32)
+        )
