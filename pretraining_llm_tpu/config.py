@@ -88,12 +88,17 @@ class ModelConfig:
     remat: str = "none"  # none | full | dots_saveable | save_attn | save_attn_res | save_qkv_attn | save_big
     # CE head implementation: "chunked" scans token chunks, backward
     # recomputes each chunk's logits (default; handles bias + vocab-sharded
-    # TP heads); "fused" runs the Pallas online-logsumexp kernel
-    # (ops/pallas_ce.py) — no logits ever reach HBM, degrades loudly to
-    # chunked for biased or tensor-sharded heads; "dense" SAVES the
-    # compute-dtype logits so backward recomputes nothing — S*V*2 bytes of
-    # head memory for zero recompute FLOPs (the right trade at small batch
-    # or remat="none").
+    # TP heads); "dense" SAVES the compute-dtype logits so backward
+    # recomputes nothing — S*V*2 bytes of head memory for zero recompute
+    # FLOPs (the right trade at small batch or remat="none"; won the 124M
+    # race post CE-scatter fix). "fused" is an EXPERIMENT, not a product
+    # path: the Pallas online-logsumexp kernel (ops/pallas_ce.py) is
+    # interpret-mode correct but hung the v5e chip three times across two
+    # remat configs (2026-07/08, multi-hour backend wedges) and measured
+    # SLOWER everywhere it completed (29.9-31.5% vs 40+% MFU at 124M);
+    # it is excluded from every capture campaign as a wedge class. Keep
+    # chunked/dense for real runs; degrades loudly to chunked for biased
+    # or tensor-sharded heads.
     ce_impl: str = "chunked"  # chunked | fused | dense
     # z-loss coefficient (PaLM/ST-MoE): adds z * mean(logsumexp(logits)^2)
     # to the training loss, pinning the softmax normalizer near 0 —
@@ -524,6 +529,17 @@ class TrainConfig:
     adam_b2: float = 0.95
     adam_eps: float = 1e-8
     grad_clip: float = 1.0  # 0 disables
+    # Gradient STORAGE dtype. "float32" (default): the backward's output
+    # tree materializes in fp32 — exact, but at 1B it is ~5 GB of the
+    # 16 GB chip, the term that pins the batch knee at b8. "bfloat16":
+    # the step differentiates a bf16 view of the params, so the gradient
+    # tree (and the microbatch accumulator) stores bf16 — half the HBM.
+    # Norm/clip math and every optimizer update still reduce in fp32
+    # per-leaf (clip_by_global_norm and the updates upcast internally);
+    # only the stored tree narrows. Precision note: bf16 grads shift
+    # training numerics slightly (Adafactor's RMS normalization absorbs
+    # most of it); parity/golden runs keep float32.
+    grad_dtype: str = "float32"  # float32 | bfloat16
     # Exponential moving average of the params (0 = off): a fp32 shadow
     # updated after every optimizer step (ema = d*ema + (1-d)*params),
     # stored at state["ema"], checkpointed/sharded like the params.
@@ -570,6 +586,11 @@ class TrainConfig:
         if self.batch_size % self.microbatches != 0:
             raise ValueError(
                 f"batch_size={self.batch_size} not divisible by microbatches={self.microbatches}"
+            )
+        if self.grad_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"grad_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.grad_dtype!r}"
             )
 
 

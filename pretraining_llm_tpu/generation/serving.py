@@ -116,6 +116,8 @@ class ServingEngine:
         # forward (paged.paged_spec_round). Greedy output equals
         # target-only serving; decode dispatches drop ~(k+1)x at the
         # draft's acceptance rate.
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if (spec_k > 0) != (draft_params is not None and draft_cfg is not None):
             raise ValueError(
                 "speculative serving needs all three of draft_params, "
@@ -311,14 +313,7 @@ class ServingEngine:
         for row, req in enumerate(self.rows):
             if req is None:
                 continue
-            for tok in (int(t) for t in window[row]):
-                self.seq_lens[row] += 1  # this step wrote the pending token
-                req.generated.append(tok)
-                self.tokens[row] = tok
-                self.stats["tokens"] += 1
-                if tok == self.stop_token or len(req.generated) >= req.max_new:
-                    self._finish(req)
-                    break  # surplus window tokens for this row are discarded
+            self._consume_tokens(req, row, window[row], advance_seq=True)
 
     def _spec_step(self) -> None:
         """One speculative round for every active row: k draft proposals,
@@ -353,14 +348,9 @@ class ServingEngine:
             self.stats["spec_accepted"] = (
                 self.stats.get("spec_accepted", 0) + int(n_emit[row]) - 1
             )
-            for tok in (int(t) for t in emit[row, : int(n_emit[row])]):
-                self.seq_lens[row] += 1  # this round wrote the slot
-                req.generated.append(tok)
-                self.tokens[row] = tok
-                self.stats["tokens"] += 1
-                if tok == self.stop_token or len(req.generated) >= req.max_new:
-                    self._finish(req)
-                    break  # surplus accepted tokens are discarded
+            self._consume_tokens(
+                req, row, emit[row, : int(n_emit[row])], advance_seq=True
+            )
 
     def run(self, *, pipeline: bool = True) -> Dict[int, List[int]]:
         """Drive the engine until every submitted request has finished.
@@ -463,13 +453,26 @@ class ServingEngine:
             self._resolve_first(req)
             if req.row is None:  # first token alone finished it
                 continue
-            for tok in (int(t) for t in window[row]):
-                req.generated.append(tok)
-                self.tokens[row] = tok
-                self.stats["tokens"] += 1
-                if tok == self.stop_token or len(req.generated) >= req.max_new:
-                    self._finish(req)
-                    break  # surplus window tokens for this row are discarded
+            self._consume_tokens(req, row, window[row], advance_seq=False)
+
+    def _consume_tokens(self, req: _Request, row: int, toks,
+                        advance_seq: bool) -> None:
+        """ONE definition of per-token reaping for all three schedulers
+        (synchronous window, speculative round, pipelined reap): append
+        to the output, update the row's pending token, finish on
+        stop/max_new and DISCARD the surplus. ``advance_seq``: the
+        synchronous and speculative paths advance the frontier here (the
+        step that produced the token wrote its slot); the pipelined path
+        already advanced it at dispatch."""
+        for tok in (int(t) for t in toks):
+            if advance_seq:
+                self.seq_lens[row] += 1
+            req.generated.append(tok)
+            self.tokens[row] = tok
+            self.stats["tokens"] += 1
+            if tok == self.stop_token or len(req.generated) >= req.max_new:
+                self._finish(req)
+                break  # surplus tokens for this row are discarded
 
     def _flush_inflight(self) -> None:
         """Synchronously drain the in-flight window (pipelined mode) so

@@ -181,7 +181,24 @@ def _make_step_fn(cfg: Config, mesh: Optional[Mesh] = None):
 
     def step_fn(state: TrainState, batch: Tuple[jax.Array, jax.Array]):
         x, y = batch
-        grad_fn = jax.value_and_grad(_loss_and_metrics)
+        if tcfg.grad_dtype == "bfloat16":
+            # HBM lever (the 1B b8 knee): differentiate a bf16 VIEW of the
+            # params so the backward's output tree (and the microbatch
+            # accumulator below) stores bf16 — half the ~4 bytes/param the
+            # fp32 tree pins. The model casts params to compute dtype at
+            # every use site anyway, so the forward math is unchanged;
+            # clip and the optimizer updates upcast per-leaf internally.
+            def grad_fn(params, mx, my, mcfg, bk):
+                pb = jax.tree.map(
+                    lambda p: p.astype(jnp.bfloat16)
+                    if p.dtype == jnp.float32 else p,
+                    params,
+                )
+                return jax.value_and_grad(_loss_and_metrics)(
+                    pb, mx, my, mcfg, bk
+                )
+        else:
+            grad_fn = jax.value_and_grad(_loss_and_metrics)
 
         if n_micro == 1:
             loss, grads = grad_fn(state["params"], x, y, model_cfg, baked)
@@ -199,7 +216,19 @@ def _make_step_fn(cfg: Config, mesh: Optional[Mesh] = None):
                     jax.tree.map(jnp.add, grads_acc, grads),
                 ), None
 
-            zero_grads = jax.tree.map(jnp.zeros_like, state["params"])
+            # The accumulator matches the grad storage dtype (bf16 halves
+            # it too under grad_dtype="bfloat16" — mean-of-microbatches in
+            # bf16 is the documented precision trade of that knob).
+            gdt = (
+                jnp.bfloat16 if tcfg.grad_dtype == "bfloat16" else None
+            )
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros_like(
+                    p,
+                    dtype=gdt if (gdt and p.dtype == jnp.float32) else p.dtype,
+                ),
+                state["params"],
+            )
             (loss_sum, grad_sum), _ = jax.lax.scan(
                 micro_step, (jnp.zeros((), jnp.float32), zero_grads), (xm, ym)
             )

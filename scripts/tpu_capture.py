@@ -420,6 +420,15 @@ def _run_stages(args, on, gated, risky, py) -> None:
             # Past-the-knee probe on the champion arm.
             ["--preset", "llama3-1b-gqa", "--optimizer", "adafactor",
              "--remat", "full", "--batch", "12"],
+            # bf16 gradient tree (train.grad_dtype): frees ~2.5 GB of the
+            # ~5 GB fp32 grads at 1B — the HBM term that pins the b8
+            # knee. fp32-per-leaf optimizer math unchanged; OOM clean.
+            ["--preset", "llama-1b", "--optimizer", "adafactor",
+             "--remat", "full", "--batch", "12",
+             "--grad-dtype", "bfloat16"],
+            ["--preset", "llama-1b", "--optimizer", "adafactor",
+             "--remat", "full", "--batch", "16",
+             "--grad-dtype", "bfloat16"],
         ):
             gated(
                 "mfu-1b-wave5:" + "/".join(extra).replace("--", ""),

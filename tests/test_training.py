@@ -47,6 +47,65 @@ def test_loss_decreases_single_device(tmp_path):
     assert last < first - 1.0, (first, last)
 
 
+def test_bf16_grad_dtype_trains_and_tracks_fp32():
+    """grad_dtype='bfloat16' (the 1B HBM lever): training still learns,
+    and a single step's parameter update stays close to the fp32-grad
+    update (the knob narrows STORAGE; optimizer math reduces in fp32)."""
+    cfg32 = _tiny_config(train_steps=5, lr=1e-3)
+    cfg16 = _tiny_config(train_steps=5, lr=1e-3, grad_dtype="bfloat16")
+    state32 = ts.init_train_state(cfg32, jax.random.key(0))
+    state16 = jax.tree.map(jnp.copy, state32)
+    it = _batch(cfg32)
+    x, y = next(it)
+    b = (jnp.asarray(x), jnp.asarray(y))
+    new32, m32 = ts.build_train_step(cfg32, mesh=None)(state32, b)
+    new16, m16 = ts.build_train_step(cfg16, mesh=None)(state16, b)
+    # Same forward -> same loss to bf16 tolerance.
+    assert abs(float(m32["loss"]) - float(m16["loss"])) < 0.05
+    # The full update vectors point the same way. (Per-coordinate
+    # comparison is ill-posed here: Adam's first step is sign-like, so a
+    # bf16-noise grad flip on a near-zero coordinate moves it by a full
+    # 2*lr — cosine over the whole update is the storage-narrowing claim.)
+    params0 = ts.init_train_state(cfg32, jax.random.key(0))["params"]
+    u32 = np.concatenate([
+        (np.asarray(a, np.float32) - np.asarray(c, np.float32)).ravel()
+        for a, c in zip(jax.tree.leaves(new32["params"]), jax.tree.leaves(params0))
+    ])
+    u16 = np.concatenate([
+        (np.asarray(b, np.float32) - np.asarray(c, np.float32)).ravel()
+        for b, c in zip(jax.tree.leaves(new16["params"]), jax.tree.leaves(params0))
+    ])
+    cos = float(u32 @ u16 / (np.linalg.norm(u32) * np.linalg.norm(u16) + 1e-12))
+    assert cos > 0.8, cos
+    # And it actually LEARNS over a few steps.
+    cfg = _tiny_config(train_steps=30, lr=3e-3, grad_dtype="bfloat16")
+    state = ts.init_train_state(cfg, jax.random.key(0))
+    step = ts.build_train_step(cfg, mesh=None)
+    it = _batch(cfg)
+    first = None
+    for _ in range(30):
+        x, y = next(it)
+        state, metrics = step(state, (jnp.asarray(x), jnp.asarray(y)))
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first - 0.3
+
+
+def test_bf16_grad_dtype_microbatch_accumulator():
+    """The accumulation path under grad_dtype='bfloat16' runs and learns
+    (the accumulator itself stores bf16 — the documented trade)."""
+    cfg = _tiny_config(
+        train_steps=5, lr=1e-3, microbatches=4, grad_dtype="bfloat16"
+    )
+    state = ts.init_train_state(cfg, jax.random.key(0))
+    step = ts.build_train_step(cfg, mesh=None)
+    it = _batch(cfg)
+    for _ in range(5):
+        x, y = next(it)
+        state, metrics = step(state, (jnp.asarray(x), jnp.asarray(y)))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_microbatch_accumulation_matches_full_batch():
     # fp32 compute so the only difference is the accumulation structure
     # (bf16 reduction-order noise would otherwise dominate the comparison).
