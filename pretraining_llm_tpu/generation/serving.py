@@ -271,18 +271,44 @@ class ServingEngine:
     def has_work(self) -> bool:
         return bool(self.waiting) or self.n_active > 0
 
+    def _window_len(self) -> int:
+        """Effective decode-window length: ``steps_per_sched`` clamped by
+        the active rows' token budget. When every row needs at most R more
+        tokens, a full window wastes (sps - R) lockstep steps on rows that
+        already finished — the tail-latency term at large windows. The
+        clamp buckets UP to a power of two so the jit cache stays at
+        log2(sps) window-program variants instead of one per residual
+        length. (Pipelined mode sees n_generated one window stale: the
+        clamp then OVERestimates the budget — never truncates a live
+        row.)"""
+        n = self.steps_per_sched
+        if n <= 1:
+            return max(1, n)
+        rem = max(
+            (req.max_new - req.n_generated for req in self.rows
+             if req is not None),
+            default=n,
+        )
+        if rem >= n:
+            return n
+        b = 1
+        while b < max(1, rem):
+            b <<= 1
+        return min(b, n)
+
     def step(self) -> None:
         """One scheduling round: admit -> grow/preempt -> a window of
-        ``steps_per_sched`` lockstep decode steps (or ONE speculative
-        round when spec_k is set) -> reap. A no-op when nothing is
-        running or waiting."""
+        ``steps_per_sched`` lockstep decode steps (clamped to the active
+        rows' remaining-token budget, or ONE speculative round when
+        spec_k is set) -> reap. A no-op when nothing is running or
+        waiting."""
         self._admit()
         if self.n_active == 0:
             return
         if self.spec_k:
             self._spec_step()
             return
-        n = self.steps_per_sched
+        n = self._window_len()
         self._ensure_write_pages(horizon=n)
         if self.n_active == 0:  # everyone got preempted (tiny pool)
             return
@@ -382,11 +408,18 @@ class ServingEngine:
         assert self._inflight is None, "re-entrant run()"
         while self.has_work() or self._inflight is not None:
             self._admit(defer=True)
+            n = self._window_len()
             if self.n_active:
-                self._ensure_write_pages(horizon=self.steps_per_sched)
+                # ONE window length for both the page horizon and the
+                # dispatch: ensure_write_pages may flush/preempt (which
+                # only shrinks the remaining budget), and a dispatch
+                # longer than the ensured horizon would scratch-redirect
+                # live writes — computing n once makes that impossible
+                # by construction, not by a cross-call invariant.
+                self._ensure_write_pages(horizon=n)
             prev = self._inflight
             if self.n_active:
-                self._inflight = self._dispatch_window()
+                self._inflight = self._dispatch_window(n)
             else:
                 self._inflight = None
             if prev is not None:
@@ -395,14 +428,14 @@ class ServingEngine:
                 self._reap_window(prev)
         return self.finished
 
-    def _dispatch_window(self) -> tuple:
+    def _dispatch_window(self, n: int) -> tuple:
         """Enqueue one ``steps_per_sched``-step decode window WITHOUT
         waiting for the previous one: input tokens come from the previous
         window's last column (still on device) merged with admission
         first-tokens (also on device); seq_lens advance host-side by the
         window length (every active row writes exactly that many slots,
-        finished-or-not — surplus is discarded at reap)."""
-        n = self.steps_per_sched
+        finished-or-not — surplus is discarded at reap). ``n`` is the
+        window length the caller already ensured pages for."""
         capacity = self.max_blocks * self.block_size
         # Clamp: a finished-but-unreaped row may have written up to its
         # full allocation; feeding seq == capacity would trip the bounds

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Auto-commit newly banked capture records so bench.py's `last_banked`
+# fallback can cite a COMMITTED record (value + capture path + commit
+# hash) even when the campaign lands numbers while no one is driving the
+# session. Polls every ~7 min; commits ONLY the campaign log file, and
+# logs git failures (a lost index-lock race or missing identity must be
+# visible, not silently skipped until the next interval).
+LOG=${1:-/root/repo/data/captures/tpu_capture_r05.jsonl}
+INTERVAL=${2:-420}
+cd /root/repo || exit 1
+while true; do
+  sleep "$INTERVAL"
+  if [ -n "$(git status --porcelain -- "$LOG" 2>/dev/null)" ]; then
+    ERR=$(git add -- "$LOG" 2>&1 \
+          && git commit -q -m "Capture log: bank r5 campaign records ($(date -u +%H:%M)Z)" \
+               -- "$LOG" 2>&1)
+    if [ $? -eq 0 ]; then
+      echo "$(date -u +%H:%M)Z committed new capture records"
+    else
+      echo "$(date -u +%H:%M)Z commit failed: $ERR" >&2
+    fi
+  fi
+done

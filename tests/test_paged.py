@@ -428,6 +428,26 @@ def test_engine_pipeline_preemption_match(params, pipeline):
         assert out[rid] == _reference_greedy(params, CFG, p, n_new)
 
 
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_engine_window_budget_clamp(params, pipeline):
+    """A 32-step scheduling window with max_new=5 must CLAMP its decode
+    windows to the rows' remaining-token budget (pow2-bucketed) instead
+    of burning 32 lockstep steps per dispatch — outputs unchanged."""
+    prompts = _prompts(2)
+    n_new = 5
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=32, block_size=8,
+        temperature=0.0, steps_per_sched=32,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run(pipeline=pipeline)
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+    # 5 tokens/request: 1 from prefill + <= 8 window steps (pow2 bucket of
+    # the 4 remaining), NOT 32+ — the clamp is the assertion.
+    assert eng.stats["steps"] <= 16, eng.stats
+
+
 def test_engine_pipelined_max_new_one(params):
     """max_new=1 requests finish on their deferred admission token alone;
     the row must free and be reusable without a dispatched window."""
