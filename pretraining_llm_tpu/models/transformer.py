@@ -329,22 +329,27 @@ def _attention_block(
                 "v_pool": scatter(kv["v_pool"], v),
             }
 
-        if cfg.paged_attention_impl == "kernel" and not quantized and tq == 1:
+        if cfg.paged_attention_impl == "kernel" and not quantized:
             # Gather-free: the Pallas kernel DMAs each row's pages straight
             # off the pool via the block table (ops/pallas_paged.py) — the
             # row's KV bytes are read once, no (B, kv_len) copy is ever
-            # materialized. (int8 pools keep the gather below: validation
+            # materialized. tq > 1 routes the multi-token form (the
+            # speculative verify's per-query frontiers live inside the
+            # kernel mask). (int8 pools keep the gather below: validation
             # rejects the combination at config time.)
             from pretraining_llm_tpu.ops.pallas_paged import (
                 paged_decode_attention,
             )
 
+            qin = q[:, 0] if tq == 1 else q
             out = paged_decode_attention(
-                q[:, 0].astype(cdt),
+                qin.astype(cdt),
                 new_kv["k_pool"].astype(cdt),
                 new_kv["v_pool"].astype(cdt),
                 tables, seq, window=cfg.sliding_window,
-            )[:, None]
+            )
+            if tq == 1:
+                out = out[:, None]
         else:
             max_blocks = tables.shape[1]
             kv_len = max_blocks * block_size

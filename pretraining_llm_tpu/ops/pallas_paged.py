@@ -48,18 +48,19 @@ NEG_INF = -1e30  # finite: exp/max edge cases (same constant as pallas_flash)
 def _paged_kernel(
     tbl_ref,  # (B, nb) int32 scalar-prefetch (SMEM)
     seq_ref,  # (B,) int32 scalar-prefetch (SMEM)
-    q_ref,  # (1, H, Dh)
+    q_ref,  # (1, H*T, Dh) — heads-major fold, query t at row h*T + t
     k_ref,  # (1, bs, G, Dh) — the page tbl[b, j]
     v_ref,  # (1, bs, G, Dh)
-    o_ref,  # (1, H, Dh)
-    acc,  # VMEM (H, Dh) f32
-    m_scr,  # VMEM (H, 1) f32
-    l_scr,  # VMEM (H, 1) f32
+    o_ref,  # (1, H*T, Dh)
+    acc,  # VMEM (H*T, Dh) f32
+    m_scr,  # VMEM (H*T, 1) f32
+    l_scr,  # VMEM (H*T, 1) f32
     *,
     bs: int,
     nb: int,
     g: int,
     n_rep: int,
+    t: int,
     scale: float,
     window: int,
 ):
@@ -73,50 +74,57 @@ def _paged_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
 
     seq = seq_ref[b]
-    # Block liveness: any linear slot in [j*bs, j*bs+bs) with slot <= seq
-    # (slot seq holds the token just written — inclusive, exactly the
-    # gather path's mask). Sliding window also kills blocks entirely
-    # below the window.
-    run = j * bs <= seq
+    # Block liveness: any linear slot in [j*bs, j*bs+bs) visible to any
+    # of the T queries — query t's frontier is seq + t (slot seq + t
+    # holds its just-written token: inclusive, exactly the gather path's
+    # per-query mask). Sliding window kills blocks entirely below the
+    # OLDEST query's window.
+    run = j * bs <= seq + (t - 1)
     if window:
         run = jnp.logical_and(run, j * bs + bs - 1 > seq - window)
 
     @pl.when(run)
     def _compute():
-        lin = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-        valid = lin <= seq  # (1, bs)
+        rows = n_rep * t
+        # Per-row frontier: row r within a group is query (r % t) of head
+        # (r // t) — the heads-major fold keeps each GQA group's rows
+        # contiguous so the static slice below works, at the price of
+        # this tiny modulo iota.
+        t_of_row = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) % t
+        lin = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        valid = lin <= seq + t_of_row  # (n_rep*T, bs)
         if window:
-            valid = jnp.logical_and(valid, lin > seq - window)
-        q = q_ref[0]  # (H, Dh)
+            valid = jnp.logical_and(valid, lin > seq + t_of_row - window)
+        q = q_ref[0]  # (H*T, Dh)
         k = k_ref[0]  # (bs, G, Dh)
         v = v_ref[0]
         for grp in range(g):
-            rows = slice(grp * n_rep, (grp + 1) * n_rep)
-            qg = q[rows]  # (n_rep, Dh)
+            sl = slice(grp * rows, (grp + 1) * rows)
+            qg = q[sl]  # (n_rep*T, Dh)
             kg = k[:, grp]  # (bs, Dh)
             vg = v[:, grp]
             s = jax.lax.dot_general(
                 qg, kg, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # (n_rep, bs)
+            ) * scale  # (n_rep*T, bs)
             s = jnp.where(valid, s, NEG_INF)
-            m_prev = m_scr[rows]  # (n_rep, 1)
+            m_prev = m_scr[sl]  # (n_rep*T, 1)
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new)
-            # A fully-window-masked row keeps m == NEG_INF -> exp(s-m)=1
-            # for masked entries; zero by the mask itself (flash kernel
+            # A fully-masked row keeps m == NEG_INF -> exp(s-m)=1 for
+            # masked entries; zero by the mask itself (flash kernel
             # discipline).
             p = jnp.where(valid, p, 0.0)
-            l_scr[rows] = l_scr[rows] * alpha + jnp.sum(
+            l_scr[sl] = l_scr[sl] * alpha + jnp.sum(
                 p, axis=-1, keepdims=True
             )
-            m_scr[rows] = m_new
+            m_scr[sl] = m_new
             pv = jax.lax.dot_general(
                 p.astype(vg.dtype), vg, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            acc[rows] = acc[rows] * alpha + pv
+            acc[sl] = acc[sl] * alpha + pv
 
     @pl.when(j == nb - 1)
     def _finalize():
@@ -125,21 +133,22 @@ def _paged_kernel(
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "interpret"))
-def _paged_call(q, k_pool, v_pool, block_tables, seq_lens, window, interpret):
-    b, h, d = q.shape
+@functools.partial(jax.jit, static_argnames=("t", "window", "interpret"))
+def _paged_call(q, k_pool, v_pool, block_tables, seq_lens, t, window,
+                interpret):
+    b, ht, d = q.shape  # ht == H * T, heads-major fold
     n_blocks, bs, g, _ = k_pool.shape
     nb = block_tables.shape[1]
-    n_rep = h // g
+    n_rep = ht // (g * t)
     kernel = functools.partial(
-        _paged_kernel, bs=bs, nb=nb, g=g, n_rep=n_rep,
+        _paged_kernel, bs=bs, nb=nb, g=g, n_rep=n_rep, t=t,
         scale=1.0 / (d**0.5), window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nb),
         in_specs=[
-            pl.BlockSpec((1, h, d), lambda bb, j, tbl, seq: (bb, 0, 0)),
+            pl.BlockSpec((1, ht, d), lambda bb, j, tbl, seq: (bb, 0, 0)),
             pl.BlockSpec(
                 (1, bs, g, d),
                 lambda bb, j, tbl, seq: (tbl[bb, j], 0, 0, 0),
@@ -149,43 +158,57 @@ def _paged_call(q, k_pool, v_pool, block_tables, seq_lens, window, interpret):
                 lambda bb, j, tbl, seq: (tbl[bb, j], 0, 0, 0),
             ),
         ],
-        out_specs=pl.BlockSpec((1, h, d), lambda bb, j, tbl, seq: (bb, 0, 0)),
+        out_specs=pl.BlockSpec((1, ht, d), lambda bb, j, tbl, seq: (bb, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, d), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((ht, d), jnp.float32),
+            pltpu.VMEM((ht, 1), jnp.float32),
+            pltpu.VMEM((ht, 1), jnp.float32),
         ],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, ht, d), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       q, k_pool, v_pool)
 
 
 def paged_decode_attention(
-    q: jax.Array,  # (B, H, Dh) — one query token per row
+    q: jax.Array,  # (B, H, Dh) or (B, T, H, Dh) — T queries per row
     k_pool: jax.Array,  # (n_blocks, block_size, G, Dh)
     v_pool: jax.Array,
     block_tables: jax.Array,  # (B, max_blocks) int32, 0-padded tails
-    seq_lens: jax.Array,  # (B,) int32 — slot seq_len holds this step's K/V
+    seq_lens: jax.Array,  # (B,) int32 — slot seq_len + t holds query t's K/V
     *,
     window: int = 0,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Single-token paged decode attention straight off the block pool.
+    """Paged decode attention straight off the block pool.
 
-    Returns (B, H, Dh). Numerics match the gather path (pool[tables]
-    assembly + masked einsum) to accumulation-order tolerance; the HBM
-    win is structural — the row's KV bytes are read ONCE, no gathered
-    copy is ever written. `interpret=None` auto-selects: compiled on
-    TPU, interpreter elsewhere (tests).
+    (B, H, Dh) is the serving decode step (one query per row); a 4-dim
+    (B, T, H, Dh) q is the multi-token form (the speculative verify):
+    query t sits at logical slot seq + t and sees slots <= seq + t —
+    exactly the gather path's per-query frontier masks. Returns q's
+    shape. Numerics match the gather path to accumulation-order
+    tolerance; the HBM win is structural — the row's KV bytes are read
+    ONCE, no gathered copy is ever written. `interpret=None`
+    auto-selects: compiled on TPU, interpreter elsewhere (tests).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    b, h, d = q.shape
+    multi = q.ndim == 4
+    if multi:
+        b, t, h, d = q.shape
+        # Heads-major fold (H*T rows, query t of head h at row h*T + t):
+        # keeps each GQA group's rows CONTIGUOUS so the kernel's static
+        # group slices work; the transpose is B*T*H*D elements (tiny at
+        # decode shapes).
+        qf = q.transpose(0, 2, 1, 3).reshape(b, h * t, d)
+    else:
+        b, h, d = q.shape
+        t = 1
+        qf = q
     g = k_pool.shape[2]
     if h % g != 0:
         raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
@@ -196,6 +219,10 @@ def paged_decode_attention(
             f"tables {block_tables.shape} / seq_lens {seq_lens.shape} do not "
             f"match batch {b}"
         )
-    return _paged_call(
-        q, k_pool, v_pool, block_tables, seq_lens, int(window), bool(interpret)
+    out = _paged_call(
+        qf, k_pool, v_pool, block_tables, seq_lens, t, int(window),
+        bool(interpret),
     )
+    if multi:
+        return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out

@@ -840,6 +840,44 @@ def _run_stages(args, on, gated, risky, py) -> None:
                 [py, BENCH, "--skip-canary", "--mode", "serving",
                  "--spec-draft", "self", "--spec-k", str(k)], 1200,
             )
+        # Spec + the Pallas kernel: draft steps run the single-token
+        # kernel, the verify the multi-token form — the same Mosaic class
+        # as serving-kernel, so this arm runs ONLY once a clean
+        # serving-kernel record is banked in this campaign log (a wedge
+        # or absence there must not re-probe the class; enforced here,
+        # not by stage ordering).
+        kernel_proven = False
+        try:
+            with open(args.out) as _f:
+                for _line in _f:
+                    try:
+                        _rec = json.loads(_line)
+                    except json.JSONDecodeError:
+                        continue
+                    if (
+                        str(_rec.get("stage", "")).startswith("serving-kernel")
+                        and _rec.get("rc") == 0
+                    ):
+                        kernel_proven = True
+        except OSError:
+            pass
+        if kernel_proven:
+            risky(
+                "serving-spec:k4-kernel",
+                [py, BENCH, "--skip-canary", "--mode", "serving",
+                 "--spec-draft", "self", "--spec-k", "4",
+                 "--paged-attn", "kernel"], 1200,
+            )
+        else:
+            rec = {"stage": "serving-spec:k4-kernel", "skipped": True,
+                   "risk": "unproven",
+                   "error": "deferred: no clean serving-kernel record "
+                            "banked in this campaign (kernel class "
+                            "unproven or wedged)"}
+            with open(args.out, "a") as _f:
+                _f.write(json.dumps(rec) + "\n")
+            print("[capture] serving-spec:k4-kernel deferred (kernel class "
+                  "not proven in this log)", flush=True)
 
     # 9e. The rest of the grid — RISKY (open-ended combos).
     if on("sweep-full"):

@@ -576,6 +576,27 @@ def test_spec_serving_preemption_and_stop(params, draft_params):
         assert out[rid] == want, f"request {rid}"
 
 
+def test_spec_serving_kernel_path_matches_generate(params, draft_params):
+    """Speculative serving with paged_attention_impl='kernel': the draft
+    steps run the single-token kernel, the verify runs the multi-token
+    kernel — greedy output must still equal dense-cache target-only
+    decoding."""
+    cfgk = dataclasses.replace(CFG, paged_attention_impl="kernel")
+    draft_k = dataclasses.replace(DRAFT_CFG, paged_attention_impl="kernel")
+    prompts = _prompts(2)
+    n_new = 8
+    eng = ServingEngine(
+        params, cfgk, max_batch=2, n_blocks=32, block_size=8,
+        temperature=0.0, draft_params=draft_params, draft_cfg=draft_k,
+        spec_k=3,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run()
+    assert eng.stats["spec_rounds"] > 0
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
 def test_spec_serving_validation(params, draft_params):
     with pytest.raises(ValueError, match="all three"):
         ServingEngine(params, CFG, spec_k=2)

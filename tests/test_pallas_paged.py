@@ -28,23 +28,31 @@ def _random_state(rng, b, n_blocks, max_blocks, bs):
     return tables, seq
 
 
-def _gather_ref(q, kp, vp, tables, seq, window):
-    b, h, d = q.shape
+def _gather_ref_multi(q, kp, vp, tables, seq, window):
+    """(B, T, H, D) reference: query t's frontier is seq + t. The single-
+    token reference below is the T=1 slice of this — ONE source of truth
+    for the mask/softmax numerics."""
+    b, t, h, d = q.shape
     g = kp.shape[2]
     n_rep = h // g
     kv_len = tables.shape[1] * kp.shape[1]
     ck = jnp.repeat(kp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
     cv = jnp.repeat(vp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
     lin = jnp.arange(kv_len)
-    mask = lin[None, :] <= seq[:, None]
+    pos = seq[:, None] + jnp.arange(t)[None, :]  # (B, T)
+    mask = lin[None, None, :] <= pos[:, :, None]  # (B, T, kv_len)
     if window:
-        mask = mask & (lin[None, :] > seq[:, None] - window)
+        mask = mask & (lin[None, None, :] > pos[:, :, None] - window)
     s = jnp.einsum(
-        "bhd,bkhd->bhk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        "bthd,bkhd->bthk", q.astype(jnp.float32), ck.astype(jnp.float32)
     ) / np.sqrt(d)
-    s = jnp.where(mask[:, None, :], s, -1e30)
+    s = jnp.where(mask[:, :, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhk,bkhd->bhd", p, cv.astype(jnp.float32))
+    return jnp.einsum("bthk,bkhd->bthd", p, cv.astype(jnp.float32))
+
+
+def _gather_ref(q, kp, vp, tables, seq, window):
+    return _gather_ref_multi(q[:, None], kp, vp, tables, seq, window)[:, 0]
 
 
 @pytest.mark.parametrize("g,window", [(8, 0), (2, 0), (4, 12), (1, 0)])
@@ -93,6 +101,27 @@ def test_kernel_seq_zero_and_full():
         q, kp, vp, jnp.asarray(tables), jnp.asarray(seq)
     )
     ref = _gather_ref(q, kp, vp, tables, seq, 0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("g,t,window", [(4, 5, 0), (2, 3, 0), (4, 4, 12)])
+def test_kernel_multitoken_matches_gather(g, t, window):
+    """The (B, T, H, D) form (speculative verify): per-query frontiers
+    seq+t inside the kernel mask == the gather path's 3D mask."""
+    rng = np.random.default_rng(g * 31 + t)
+    b, h, d, bs, n_blocks, max_blocks = 2, 8, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq = _random_state(rng, b, n_blocks, max_blocks, bs)
+    # Keep every query's write slot within capacity (the engine's page
+    # horizon guarantees this in real use).
+    seq = np.minimum(seq, max_blocks * bs - t)
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), window=window
+    )
+    assert out.shape == (b, t, h, d)
+    ref = _gather_ref_multi(q, kp, vp, tables, seq, window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
