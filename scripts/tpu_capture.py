@@ -68,6 +68,44 @@ RISKY_STAGES = frozenset(
 )
 
 
+def _log_records(out_path: str):
+    """Yield parsed records from a campaign log, skipping undecodable
+    lines — the ONE definition of log iteration (the log is append-only
+    JSONL shared across campaigns)."""
+    try:
+        with open(out_path) as f:
+            for ln in f:
+                try:
+                    yield json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return
+
+
+def _stage_proven_this_campaign(out_path: str, prefix: str) -> bool:
+    """True when THIS campaign (records after the last campaign-start
+    marker) banked a clean run of a stage matching ``prefix``: rc == 0,
+    no error, and NOT flagged backend_wedged (an rc==0 bench race whose
+    later candidate wedged the chip proves nothing about probing the
+    class again). Scoping + the wedge check exist for the same reason as
+    _critical_banked's latest-record semantics: stale or poisoned
+    records must never unlock a risky probe."""
+    proven = False
+    for r in _log_records(out_path):
+        if r.get("stage") == "campaign-start":
+            proven = False  # scope to the current campaign
+            continue
+        if (
+            str(r.get("stage", "")).startswith(prefix)
+            and r.get("rc") == 0
+            and not r.get("error")
+            and not r.get("backend_wedged")
+        ):
+            proven = True
+    return proven
+
+
 def _critical_banked(out_path: str) -> set:
     """Critical stages whose LATEST record in the campaign log is a
     completed measurement.
@@ -83,18 +121,10 @@ def _critical_banked(out_path: str) -> set:
     campaign — the most recent attempt decides.
     """
     latest: dict = {}
-    try:
-        with open(out_path) as f:
-            for ln in f:
-                try:
-                    r = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue
-                stage = r.get("stage", "")
-                if stage in CRITICAL_STAGES:
-                    latest[stage] = r
-    except OSError:
-        pass
+    for r in _log_records(out_path):
+        stage = r.get("stage", "")
+        if stage in CRITICAL_STAGES:
+            latest[stage] = r
     done: set = set()
     for stage, r in latest.items():
         if "error" in r:
@@ -731,6 +761,19 @@ def _run_stages(args, on, gated, risky, py) -> None:
                 1200,
             )
 
+    # 8d. Mid-campaign bank refresh (VERDICT r4 #8): the gated tier above
+    # can take hours; re-race the default config under CURRENT conditions
+    # before the risky tier starts (whose probes can wedge the chip and
+    # end the session) so last_banked is never older than the last safe
+    # moment.
+    if on("mfu-refresh"):
+        gated(
+            "mfu-refresh-mid",
+            [py, BENCH, "--skip-canary", "--quick",
+             "--timeout-budget", "600"],
+            720,
+        )
+
     # --- RISKY TIER from here down: unproven kernel-config classes, run
     # only after mfu + parity-tpu + e2e are banked (see module docstring).
 
@@ -842,26 +885,11 @@ def _run_stages(args, on, gated, risky, py) -> None:
             )
         # Spec + the Pallas kernel: draft steps run the single-token
         # kernel, the verify the multi-token form — the same Mosaic class
-        # as serving-kernel, so this arm runs ONLY once a clean
-        # serving-kernel record is banked in this campaign log (a wedge
-        # or absence there must not re-probe the class; enforced here,
-        # not by stage ordering).
-        kernel_proven = False
-        try:
-            with open(args.out) as _f:
-                for _line in _f:
-                    try:
-                        _rec = json.loads(_line)
-                    except json.JSONDecodeError:
-                        continue
-                    if (
-                        str(_rec.get("stage", "")).startswith("serving-kernel")
-                        and _rec.get("rc") == 0
-                    ):
-                        kernel_proven = True
-        except OSError:
-            pass
-        if kernel_proven:
+        # as serving-kernel, so this arm runs ONLY once THIS campaign
+        # banked a clean (rc==0, unwedged) serving-kernel record (a
+        # wedge, a stale prior-round success, or absence must not
+        # re-probe the class; enforced here, not by stage ordering).
+        if _stage_proven_this_campaign(args.out, "serving-kernel"):
             risky(
                 "serving-spec:k4-kernel",
                 [py, BENCH, "--skip-canary", "--mode", "serving",

@@ -187,6 +187,44 @@ def test_full_campaign_defers_risky_when_criticals_fail(
     )
 
 
+def test_stage_proven_this_campaign_semantics(tmp_path):
+    """The spec-kernel prerequisite gate: only a clean (rc==0, no error,
+    unwedged) serving-kernel record from THIS campaign (after the last
+    campaign-start marker) counts — wedged rc==0 records and stale
+    prior-round successes must not unlock the class."""
+    import json as _json
+
+    log = tmp_path / "cap.jsonl"
+
+    def write(recs):
+        log.write_text("".join(_json.dumps(r) + "\n" for r in recs))
+
+    proven = lambda: tpu_capture._stage_proven_this_campaign(
+        str(log), "serving-kernel")
+    # Missing log: nothing proven.
+    assert not tpu_capture._stage_proven_this_campaign(
+        str(tmp_path / "absent.jsonl"), "serving-kernel")
+    # Clean record in this campaign: proven.
+    write([{"stage": "campaign-start"},
+           {"stage": "serving-kernel:sps32", "rc": 0}])
+    assert proven()
+    # rc==0 but the backend wedged during the stage: NOT proven.
+    write([{"stage": "campaign-start"},
+           {"stage": "serving-kernel:sps32", "rc": 0,
+            "backend_wedged": True}])
+    assert not proven()
+    # Clean record from a PREVIOUS campaign only: NOT proven.
+    write([{"stage": "campaign-start"},
+           {"stage": "serving-kernel:sps32", "rc": 0},
+           {"stage": "campaign-start"},
+           {"stage": "mfu", "rc": 0}])
+    assert not proven()
+    # Failed in this campaign after succeeding earlier: NOT proven.
+    write([{"stage": "campaign-start"},
+           {"stage": "serving-kernel:sps32", "rc": 1, "error": "hang"}])
+    assert not proven()
+
+
 def test_missing_log_means_nothing_banked(tmp_path):
     assert tpu_capture._critical_banked(str(tmp_path / "absent.jsonl")) == set()
 
