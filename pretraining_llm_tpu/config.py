@@ -531,14 +531,19 @@ class TrainConfig:
     grad_clip: float = 1.0  # 0 disables
     # Gradient STORAGE dtype. "float32" (default): the backward's output
     # tree materializes in fp32 — exact, but at 1B it is ~5 GB of the
-    # 16 GB chip, the term that pins the batch knee at b8. "bfloat16":
-    # the step differentiates a bf16 view of the params, so the gradient
-    # tree (and the microbatch accumulator) stores bf16 — half the HBM.
-    # Norm/clip math and every optimizer update still reduce in fp32
-    # per-leaf (clip_by_global_norm and the updates upcast internally);
-    # only the stored tree narrows. Precision note: bf16 grads shift
-    # training numerics slightly (Adafactor's RMS normalization absorbs
-    # most of it); parity/golden runs keep float32.
+    # 16 GB chip, the term that pins the batch knee at b8 when the
+    # end-of-backward state is the peak. "bfloat16": each gradient leaf
+    # is cast to bf16 as the backward produces it (XLA fuses the convert
+    # into the producer), so the gradient tree and the microbatch
+    # accumulator store 2 bytes/param; the fp32 cotangent chain is
+    # unchanged — grads are the fp32-path values rounded once. Norm/clip
+    # math and every optimizer update still reduce in fp32 per-leaf.
+    # Precision note: bf16 grad storage shifts training numerics
+    # slightly (Adafactor's RMS normalization absorbs most of it);
+    # parity/golden runs keep float32. (Implementation note: the
+    # alternative — differentiating a bf16 param VIEW — pins a full
+    # bf16 param copy across the backward, AOT-measured +2.8 GiB at 1B,
+    # cancelling the saving; this knob uses the cast-after-grad form.)
     grad_dtype: str = "float32"  # float32 | bfloat16
     # Exponential moving average of the params (0 = off): a fp32 shadow
     # updated after every optimizer step (ema = d*ema + (1-d)*params),

@@ -182,21 +182,27 @@ def _make_step_fn(cfg: Config, mesh: Optional[Mesh] = None):
     def step_fn(state: TrainState, batch: Tuple[jax.Array, jax.Array]):
         x, y = batch
         if tcfg.grad_dtype == "bfloat16":
-            # HBM lever (the 1B b8 knee): differentiate a bf16 VIEW of the
-            # params so the backward's output tree (and the microbatch
-            # accumulator below) stores bf16 — half the ~4 bytes/param the
-            # fp32 tree pins. The model casts params to compute dtype at
-            # every use site anyway, so the forward math is unchanged;
-            # clip and the optimizer updates upcast per-leaf internally.
+            # HBM lever (the 1B b8 knee): cast each gradient leaf to bf16
+            # IMMEDIATELY after the backward produces it — XLA fuses the
+            # convert into the producing fusion, so the end-of-backward
+            # state holds a 2-byte/param tree (and the microbatch
+            # accumulator below matches). Chosen over differentiating a
+            # bf16 param view after AOT memory analysis (2026-08-02): the
+            # up-front bf16 param copy stays PINNED across the whole
+            # backward (+2.8 GiB at 1B), cancelling the saving, while
+            # this form keeps the fp32 cotangent chain (grads are the
+            # fp32-path values rounded once) and adds no pinned copy.
+            # Clip and the optimizer updates upcast per-leaf internally.
             def grad_fn(params, mx, my, mcfg, bk):
-                pb = jax.tree.map(
-                    lambda p: p.astype(jnp.bfloat16)
-                    if p.dtype == jnp.float32 else p,
-                    params,
+                loss, g = jax.value_and_grad(_loss_and_metrics)(
+                    params, mx, my, mcfg, bk
                 )
-                return jax.value_and_grad(_loss_and_metrics)(
-                    pb, mx, my, mcfg, bk
+                g = jax.tree.map(
+                    lambda leaf: leaf.astype(jnp.bfloat16)
+                    if leaf.dtype == jnp.float32 else leaf,
+                    g,
                 )
+                return loss, g
         else:
             grad_fn = jax.value_and_grad(_loss_and_metrics)
 
