@@ -19,9 +19,11 @@ trainer's job (`device_prefetch` below double-buffers `jax.device_put`).
 
 from __future__ import annotations
 
+import atexit
 import os
 import queue
 import threading
+import weakref
 from typing import Any, Dict, Iterator, Tuple
 
 import numpy as np
@@ -276,6 +278,7 @@ class DevicePrefetcher:
             target=self._worker, args=(has_state,), daemon=True
         )
         self._thread.start()
+        _LIVE_PREFETCHERS.add(self)
 
     def _offer(self, item: Any) -> bool:
         while not self._stop.is_set():
@@ -351,6 +354,33 @@ class DevicePrefetcher:
             pass
         self._thread.join(timeout=10.0)
         return not self._thread.is_alive()
+
+
+# Interpreter-teardown guard: a daemon worker that outlives its owner
+# (a consumer that never exhausted the stream and never called close())
+# keeps calling put_fn — a device transfer — while CPython finalization
+# tears the runtime down underneath it, which can segfault inside the
+# extension (observed once in a full-suite run, 2026-08-02: prefetcher
+# thread parked in queue.put at interpreter exit). atexit runs BEFORE
+# extension teardown: stop every live worker and give each a moment to
+# park. WeakSet: the guard must not keep abandoned prefetchers alive.
+_LIVE_PREFETCHERS: "weakref.WeakSet[DevicePrefetcher]" = weakref.WeakSet()
+
+
+def _stop_live_prefetchers() -> None:
+    import time as _time
+
+    for p in list(_LIVE_PREFETCHERS):
+        p._stop.set()
+    # Shared deadline: exit latency stays ~1s total however many workers
+    # are live (a worker wedged inside a device transfer cannot be
+    # interrupted anyway — the guard is best-effort by construction).
+    deadline = _time.monotonic() + 1.0
+    for p in list(_LIVE_PREFETCHERS):
+        p._thread.join(timeout=max(0.0, deadline - _time.monotonic()))
+
+
+atexit.register(_stop_live_prefetchers)
 
 
 def device_prefetch(
