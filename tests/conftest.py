@@ -46,3 +46,20 @@ def mesh_exp4() -> Mesh:
     """2 data x 4 expert mesh for MoE expert-parallel tests."""
     devs = np.asarray(jax.devices()).reshape(2, 1, 1, 1, 4, 1)
     return Mesh(devs, AXES)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Bound the XLA CPU client's native-state accumulation.
+
+    A full-suite run compiles many hundreds of executables into ONE
+    process; twice (2026-08-02) the run segfaulted INSIDE XLA's
+    backend_compile ~430 tests deep (main-thread stack in
+    jax/_src/compiler.py backend_compile_and_load — not reproducible on
+    any module in isolation, i.e. a native accumulation effect, not a
+    test bug). Dropping the compiled-executable caches at each module
+    boundary keeps within-module reuse (fixtures' jitted fns stay hot
+    across a module's tests) while releasing the native executables of
+    every previous module."""
+    yield
+    jax.clear_caches()
