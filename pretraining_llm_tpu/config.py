@@ -600,6 +600,92 @@ class TrainConfig:
 
 
 # ---------------------------------------------------------------------------
+# Resilience
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs: anomaly detection, rollback, watchdog, faults.
+
+    Everything here is host-side and off the hot path — the detector reads
+    the metrics the trainer already fetched at log boundaries, the watchdog
+    is one idle thread, and fault injection is a no-op unless ``faults`` is
+    set. See resilience/ for the machinery and README "Fault tolerance" for
+    the operational story (return codes, supervisor).
+    """
+
+    # --- anomaly detection (log-boundary metrics; free on the hot path) ----
+    anomaly_detection: bool = False
+    # Rolling window (in log-boundary samples) the spike baselines are
+    # computed over. NaN/Inf detection needs no history and is always armed.
+    anomaly_window: int = 32
+    # Samples required before the relative-spike rules arm — an empty
+    # baseline would flag ordinary early-training noise.
+    anomaly_min_history: int = 5
+    # loss > factor * rolling-median(loss) => anomaly ("loss_spike").
+    loss_spike_factor: float = 3.0
+    # grad_norm > factor * rolling-median(grad_norm) => anomaly ("grad_spike").
+    grad_spike_factor: float = 10.0
+    # --- rollback ----------------------------------------------------------
+    # Max automatic checkpoint rollbacks per train() call; the next anomaly
+    # past the budget ends the run with exit_reason="anomaly_budget"
+    # (EXIT_ANOMALY, which the supervisor treats as fatal).
+    rollback_budget: int = 3
+    # Steps after a rollback during which new anomalies are suppressed
+    # (logged, not acted on) while the detector rebuilds its baseline.
+    cooldown_steps: int = 0
+    # Extra batches to skip PAST the poison window on rollback. The window
+    # itself (anomaly step - restored step batches) is always skipped; this
+    # adds margin when the offending data region is wider than one window.
+    skip_batches: int = 0
+    # --- watchdog ----------------------------------------------------------
+    # Host seconds without a completed step before the watchdog declares the
+    # step wedged (stuck collective / hung chip), dumps all thread stacks,
+    # attempts an emergency checkpoint, and exits EXIT_WEDGED. 0 = off.
+    # Arms only after the first step completes, so compile time is excluded.
+    watchdog_timeout_s: float = 0.0
+    # --- fault injection (tests/drills only) -------------------------------
+    # Deterministic fault plan, e.g. "nan@20,sigterm@50,hang@30,
+    # ckpt_truncate@40": each entry fires once, right before the named step
+    # executes. A resumed run does not re-fire faults at or below its start
+    # step. "" = disabled.
+    faults: str = ""
+
+    def __post_init__(self) -> None:
+        if self.anomaly_window < 2:
+            raise ValueError(
+                f"anomaly_window must be >= 2, got {self.anomaly_window}"
+            )
+        if self.anomaly_min_history < 1:
+            raise ValueError(
+                f"anomaly_min_history must be >= 1, got {self.anomaly_min_history}"
+            )
+        if self.loss_spike_factor <= 1.0 or self.grad_spike_factor <= 1.0:
+            raise ValueError(
+                "spike factors must be > 1 (a factor <= 1 flags every step): "
+                f"loss={self.loss_spike_factor}, grad={self.grad_spike_factor}"
+            )
+        if self.rollback_budget < 0:
+            raise ValueError(
+                f"rollback_budget must be >= 0, got {self.rollback_budget}"
+            )
+        if self.cooldown_steps < 0 or self.skip_batches < 0:
+            raise ValueError("cooldown_steps and skip_batches must be >= 0")
+        if self.watchdog_timeout_s < 0:
+            raise ValueError(
+                f"watchdog_timeout_s must be >= 0, got {self.watchdog_timeout_s}"
+            )
+        if self.faults:
+            # Fail fast on a malformed plan (lazy import: resilience.faults
+            # has no config dependency, but config loads first in the
+            # package import order).
+            from pretraining_llm_tpu.resilience.faults import parse_faults
+
+            parse_faults(self.faults)
+
+
+# ---------------------------------------------------------------------------
 # Top-level
 # ---------------------------------------------------------------------------
 
@@ -610,6 +696,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     name: str = "custom"
 
     # NOTE: pipeline stage assignment (P('pipe', ...) on the stacked layer
@@ -631,7 +718,7 @@ class Config:
         for key, value in overrides.items():
             if "." in key:
                 section, fname = key.split(".", 1)
-                if section not in ("model", "mesh", "data", "train"):
+                if section not in ("model", "mesh", "data", "train", "resilience"):
                     raise KeyError(f"unknown config section {section!r} in override {key!r}")
                 sections.setdefault(section, {})[fname] = value
             else:
@@ -661,6 +748,8 @@ class Config:
             mesh=MeshConfig(**{k: tuple(v) if k == "axis_names" else v for k, v in raw["mesh"].items()}),
             data=DataConfig(**raw["data"]),
             train=TrainConfig(**raw["train"]),
+            # Absent in checkpoints written before the resilience subsystem.
+            resilience=ResilienceConfig(**raw.get("resilience", {})),
             name=raw.get("name", "custom"),
         )
 

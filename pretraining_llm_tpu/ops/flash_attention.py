@@ -22,6 +22,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from pretraining_llm_tpu.utils import jax_compat
+
 
 def _pick_block(t: int, requested: int, default: int) -> int:
     if requested > 0:
@@ -189,12 +191,12 @@ def shard_mapped_kernel(kernel, q, k, v, mesh, *, batch_axes=("data", "fsdp"),
     spec = P(batch_axes, None, head_ax, None)
     if segments is not None:
         seg_spec = P(batch_axes, None)
-        return jax.shard_map(
+        return jax_compat.shard_map(
             lambda q_, k_, v_, s_: kernel(q_, k_, v_, segments=s_),
             mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
             check_vma=False,
         )(q, k, v, segments)
-    return jax.shard_map(
+    return jax_compat.shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
@@ -266,12 +268,8 @@ def flash_attention(
             # GSPMD, all-gathering the global batch — and a nested shard_map
             # over the auto axes is not expressible either; use the
             # blockwise fallback there (GSPMD partitions plain JAX ops).
-            abstract_mesh = jax.sharding.get_abstract_mesh()
-            manual_axes = {
-                name
-                for name, t in zip(abstract_mesh.axis_names, abstract_mesh.axis_types)
-                if t == jax.sharding.AxisType.Manual
-            }
+            abstract_mesh = jax_compat.get_abstract_mesh()
+            manual_axes = jax_compat.manual_axis_names(abstract_mesh)
             nontrivial = {name for name, size in mesh.shape.items() if size > 1}
             if nontrivial <= manual_axes:
                 return kernel(q, k, v, segments=segments,

@@ -46,6 +46,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Renamed upstream (TPUCompilerParams -> CompilerParams); accept either so the
+# kernel builds on 0.4.x and current JAX alike.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 # Same halve-until-divides tiling rule as the flash kernels — one source.
@@ -202,7 +206,7 @@ def _ce_fwd(h, w, labels, block_s, block_v, interpret):
             jax.ShapeDtypeStruct((s, 1), jnp.float32),
             jax.ShapeDtypeStruct((s, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
@@ -231,7 +235,7 @@ def _ce_bwd(block_s, block_v, interpret, residuals, g):
         out_specs=pl.BlockSpec((bs, d), lambda i, j: (i, 0)),
         scratch_shapes=[pltpu.VMEM((bs, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((s, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
@@ -251,7 +255,7 @@ def _ce_bwd(block_s, block_v, interpret, residuals, g):
         out_specs=pl.BlockSpec((d, bv), lambda j, i: (0, j)),
         scratch_shapes=[pltpu.VMEM((d, bv), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((d, v_pad), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,

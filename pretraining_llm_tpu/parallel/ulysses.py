@@ -22,6 +22,8 @@ from typing import Optional, Tuple
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pretraining_llm_tpu.utils import jax_compat
+
 from pretraining_llm_tpu.ops.attention import naive_attention
 
 
@@ -125,6 +127,6 @@ def ulysses_attention(
         block_q=block_q,
         block_kv=block_kv,
     )
-    return jax.shard_map(
+    return jax_compat.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)

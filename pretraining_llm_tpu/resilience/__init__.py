@@ -1,0 +1,54 @@
+"""Resilience subsystem: closes the loop from fault detection to recovery.
+
+The checkpoint/resume machinery (training/checkpoint.py) gives the repo a
+*manual* recovery story; this package makes it automatic:
+
+  - anomaly.py   — rolling-window detector over the log-boundary metrics the
+                   trainer already fetched (NaN/Inf, loss spike, grad spike);
+                   costs nothing on the hot path.
+  - rollback.py  — on anomaly: restore the last good checkpoint, advance the
+                   data-RNG frontier past the poison window, re-arm with a
+                   cooldown and a bounded rollback budget.
+  - watchdog.py  — host-side hung-step detector (wedged chip / stuck
+                   collective): dumps all thread stacks, attempts an
+                   emergency checkpoint, exits EXIT_WEDGED.
+  - faults.py    — deterministic config-driven fault injection so every
+                   recovery path is exercised in CPU tests.
+
+scripts/supervisor.py is the out-of-process half: a bounded
+exponential-backoff relauncher mapping the return codes below to restart
+policy. Configured via config.ResilienceConfig; see README "Fault tolerance".
+
+Return-code contract (consumed by scripts/supervisor.py):
+  0              clean completion — do not relaunch.
+  EXIT_PREEMPTED graceful SIGTERM stop, checkpoint written — relaunch
+                 immediately, no backoff (preemptions are routine).
+  EXIT_ANOMALY   rollback budget exhausted (or anomaly with no loadable
+                 checkpoint) — fatal, needs a human; never relaunched.
+  EXIT_WEDGED    watchdog fired on a hung step — relaunch with backoff
+                 (counts toward the restart budget).
+  anything else  crash — relaunch with backoff, counts toward the budget.
+"""
+
+EXIT_CLEAN = 0
+EXIT_PREEMPTED = 43
+EXIT_ANOMALY = 44
+EXIT_WEDGED = 45
+
+from pretraining_llm_tpu.resilience.anomaly import Anomaly, AnomalyDetector  # noqa: E402
+from pretraining_llm_tpu.resilience.faults import FaultInjector, parse_faults  # noqa: E402
+from pretraining_llm_tpu.resilience.rollback import RollbackManager  # noqa: E402
+from pretraining_llm_tpu.resilience.watchdog import StepWatchdog  # noqa: E402
+
+__all__ = [
+    "EXIT_CLEAN",
+    "EXIT_PREEMPTED",
+    "EXIT_ANOMALY",
+    "EXIT_WEDGED",
+    "Anomaly",
+    "AnomalyDetector",
+    "FaultInjector",
+    "parse_faults",
+    "RollbackManager",
+    "StepWatchdog",
+]

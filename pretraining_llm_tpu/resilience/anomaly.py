@@ -1,0 +1,92 @@
+"""Rolling-window anomaly detection over log-boundary training metrics.
+
+The trainer already pays the device->host sync to fetch loss/grad_norm at
+every log boundary; observing those floats costs nothing on the hot path —
+no extra dispatches, no per-step host work. Three rules:
+
+  nan        loss or grad_norm is NaN/Inf. Always armed (needs no history):
+             a non-finite loss never recovers on its own under Adam.
+  loss_spike loss > loss_spike_factor * rolling-median(loss). Median, not
+             mean: a single poisoned window must not drag its own baseline.
+  grad_spike grad_norm > grad_spike_factor * rolling-median(grad_norm).
+             The pre-clip global norm is the earliest scalar symptom of a
+             bad batch or a divergence — it fires before the loss moves.
+
+The spike rules arm only after ``anomaly_min_history`` finite samples so an
+empty baseline cannot flag ordinary early-training noise, and anomalous
+samples are never added to the window (a detected spike must not poison the
+baseline that detected it).
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from pretraining_llm_tpu.config import ResilienceConfig
+
+
+@dataclass(frozen=True)
+class Anomaly:
+    kind: str  # "nan" | "loss_spike" | "grad_spike"
+    step: int
+    value: float
+    threshold: float
+
+    def as_event(self) -> Dict[str, Any]:
+        return {
+            "event": "anomaly_detected",
+            "kind": self.kind,
+            "step": self.step,
+            "value": self.value,
+            "threshold": self.threshold,
+        }
+
+
+class AnomalyDetector:
+    def __init__(self, cfg: ResilienceConfig) -> None:
+        self.cfg = cfg
+        self._loss: "deque[float]" = deque(maxlen=cfg.anomaly_window)
+        self._grad: "deque[float]" = deque(maxlen=cfg.anomaly_window)
+
+    def reset(self) -> None:
+        """Drop all history (call after a rollback: the restored timeline's
+        baseline must not include the poisoned window's samples)."""
+        self._loss.clear()
+        self._grad.clear()
+
+    def observe(self, step: int, metrics: Dict[str, float]) -> Optional[Anomaly]:
+        """Feed one log boundary's metrics; returns the anomaly, if any."""
+        loss = metrics.get("loss")
+        grad = metrics.get("grad_norm")
+
+        for kind_value in (loss, grad):
+            if kind_value is not None and not math.isfinite(kind_value):
+                return Anomaly("nan", step, float(kind_value), float("nan"))
+
+        anomaly = None
+        if loss is not None and len(self._loss) >= self.cfg.anomaly_min_history:
+            baseline = statistics.median(self._loss)
+            threshold = self.cfg.loss_spike_factor * baseline
+            if baseline > 0 and loss > threshold:
+                anomaly = Anomaly("loss_spike", step, loss, threshold)
+        if (
+            anomaly is None
+            and grad is not None
+            and len(self._grad) >= self.cfg.anomaly_min_history
+        ):
+            baseline = statistics.median(self._grad)
+            threshold = self.cfg.grad_spike_factor * baseline
+            if baseline > 0 and grad > threshold:
+                anomaly = Anomaly("grad_spike", step, grad, threshold)
+
+        if anomaly is None:
+            # Only clean samples extend the baseline.
+            if loss is not None:
+                self._loss.append(loss)
+            if grad is not None:
+                self._grad.append(grad)
+        return anomaly

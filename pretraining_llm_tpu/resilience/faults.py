@@ -1,0 +1,150 @@
+"""Deterministic, config-driven fault injection.
+
+Every recovery path in this package is only trustworthy if it is exercised —
+on CPU, in tier-1 tests, not for the first time during a real multi-day run.
+The injector fires scripted faults at exact steps so tests (and operators
+running drills) can drive the full loop: inject -> detect -> recover.
+
+Plan grammar (``ResilienceConfig.faults``): comma-separated ``kind@step``
+entries, e.g. ``"nan@20,sigterm@50"``. Steps are the trainer's step counter
+(the fault fires right before that step executes, i.e. after ``step``
+completed steps). Kinds:
+
+  nan            poison the params with NaN — the next step's loss is NaN,
+                 which the anomaly detector must catch at the next log
+                 boundary and roll back.
+  sigterm        deliver SIGTERM to this process (preemption drill): the
+                 trainer's handler checkpoints and stops at the next log
+                 boundary.
+  hang           block the host loop indefinitely (wedged-chip drill): the
+                 step watchdog must fire, emergency-checkpoint, and exit
+                 EXIT_WEDGED.
+  ckpt_truncate  truncate one ``.npy`` leaf of the latest checkpoint on disk
+                 (torn-write drill): the next restore must skip it and fall
+                 back to the previous good step.
+
+Once-only semantics: each plan entry fires at most once per process, and a
+resumed run never re-fires an entry at or below its start step — so a
+supervisor relaunch after an injected hang resumes from the emergency
+checkpoint and runs clean instead of wedging forever.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, List, Optional, Tuple
+
+FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt_truncate")
+
+# How long an injected hang blocks the host loop. Effectively forever next to
+# any sane watchdog timeout; bounded so a test run without a watchdog still
+# terminates eventually instead of needing a kill -9.
+_HANG_SECONDS = 3600.0
+
+
+def parse_faults(spec: str) -> List[Tuple[str, int]]:
+    """Parse a fault plan; raises ValueError naming the offending entry."""
+    out: List[Tuple[str, int]] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, sep, at = entry.partition("@")
+        if not sep or not at:
+            raise ValueError(
+                f"malformed fault entry {entry!r} in {spec!r}: expected kind@step"
+            )
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {spec!r}; one of {FAULT_KINDS}"
+            )
+        try:
+            step = int(at)
+        except ValueError:
+            raise ValueError(
+                f"fault step must be an integer in {entry!r} (plan {spec!r})"
+            ) from None
+        if step < 1:
+            raise ValueError(
+                f"fault step must be >= 1 in {entry!r} (step 0 is never "
+                "reachable: faults fire only past the run's start step)"
+            )
+        out.append((kind, step))
+    if not out:
+        raise ValueError(f"empty fault plan {spec!r}")
+    return out
+
+
+class FaultInjector:
+    """Fires the parsed plan against a live Trainer, once per entry.
+
+    ``start_step`` is the step the run resumed from: entries at or below it
+    are considered spent (they fired in the lineage that produced the
+    checkpoint), which is what lets a supervisor relaunch make progress.
+    """
+
+    def __init__(self, spec: str, *, start_step: int = 0, logger: Any = None) -> None:
+        self.plan = parse_faults(spec)
+        self.start_step = start_step
+        self.logger = logger
+        self._fired: set = set()
+
+    def maybe_fire(self, step: int, trainer: Any) -> None:
+        for i, (kind, at) in enumerate(self.plan):
+            if at != step or at <= self.start_step or i in self._fired:
+                continue
+            self._fired.add(i)
+            if self.logger is not None:
+                self.logger.log({"event": "fault_injected", "kind": kind, "step": step})
+            getattr(self, f"_fire_{kind}")(trainer)
+
+    # -- actions -------------------------------------------------------
+
+    def _fire_nan(self, trainer: Any) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        # Multiply every param by NaN in place of the state dict — shardings
+        # are preserved (elementwise op), and the very next loss is NaN.
+        state = dict(trainer.state)
+        state["params"] = jax.tree.map(
+            lambda p: p * jnp.float32(float("nan")).astype(p.dtype),
+            state["params"],
+        )
+        trainer.state = state
+
+    def _fire_sigterm(self, trainer: Any) -> None:  # noqa: ARG002 — uniform shape
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def _fire_hang(self, trainer: Any) -> None:  # noqa: ARG002 — uniform shape
+        time.sleep(_HANG_SECONDS)
+
+    def _fire_ckpt_truncate(self, trainer: Any) -> None:
+        from pretraining_llm_tpu.training import checkpoint as ckpt
+
+        latest = ckpt.latest_checkpoint(trainer.config.train.checkpoint_dir)
+        if latest is None:
+            return
+        truncate_leaf(latest)
+
+    # expose for tests that want to corrupt a checkpoint without a plan
+    @staticmethod
+    def _noop(trainer: Any) -> None:  # pragma: no cover
+        pass
+
+
+def truncate_leaf(ckpt_path: str, leaf: Optional[str] = None) -> Optional[str]:
+    """Truncate one ``.npy`` leaf file in a checkpoint dir to half its size
+    (a torn write). Returns the damaged filename, or None if no leaf found."""
+    names = sorted(n for n in os.listdir(ckpt_path) if n.endswith(".npy"))
+    if leaf is not None:
+        names = [n for n in names if n.startswith(leaf)]
+    if not names:
+        return None
+    target = os.path.join(ckpt_path, names[0])
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return names[0]

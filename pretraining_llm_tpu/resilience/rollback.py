@@ -1,0 +1,129 @@
+"""Automatic checkpoint rollback: anomaly -> last good state -> fresh data.
+
+The recovery policy that turns detection (anomaly.py) into continued
+training without a human in the loop:
+
+  restore   the newest loadable checkpoint (checkpoint.restore_latest — it
+            GC's partial tmp dirs and digs past truncated/torn step dirs).
+            If an anomaly recurs before any NEW checkpoint lands — i.e. the
+            candidate equals the step we just restored — that checkpoint is
+            itself suspect (poison crossed a save boundary), so the retry
+            digs strictly earlier.
+  skip      the data-RNG frontier is advanced past the poison window: the
+            (anomaly_step - restored_step) batches the restored timeline
+            would replay, plus ``skip_batches`` extra margin. A loss spike
+            caused by a bad data region must not be replayed verbatim.
+  re-arm    the detector's history is cleared (the poisoned samples must not
+            seed the new baseline) and further anomalies are suppressed for
+            ``cooldown_steps`` while it rebuilds.
+  budget    at most ``rollback_budget`` rollbacks per train() call; the next
+            anomaly past the budget ends the run with EXIT_ANOMALY — an
+            anomaly that survives N rollbacks is systemic, and looping on
+            it would burn the cluster forever.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pretraining_llm_tpu.config import ResilienceConfig
+from pretraining_llm_tpu.resilience.anomaly import Anomaly
+
+
+class RollbackManager:
+    """Decides and executes rollbacks against a live Trainer.
+
+    ``handle`` returns one of:
+      "rolled_back"    state restored, data skipped; the caller continues
+                       the loop from the returned-to step.
+      "suppressed"     anomaly inside the post-rollback cooldown; ignored.
+      "exhausted"      rollback budget spent; the caller must stop.
+      "no_checkpoint"  nothing loadable to restore; the caller must stop.
+    """
+
+    def __init__(self, cfg: ResilienceConfig, logger: Any = None) -> None:
+        self.cfg = cfg
+        self.logger = logger
+        self.used = 0
+        self._cooldown_until = -1
+        self._last_restored: Optional[int] = None
+
+    def _log(self, record: dict) -> None:
+        if self.logger is not None:
+            self.logger.log(record)
+
+    def handle(self, trainer: Any, anomaly: Anomaly) -> str:
+        step = anomaly.step
+        if step < self._cooldown_until:
+            self._log({
+                "event": "anomaly_suppressed",
+                "kind": anomaly.kind,
+                "step": step,
+                "cooldown_until": self._cooldown_until,
+            })
+            return "suppressed"
+        if self.used >= self.cfg.rollback_budget:
+            self._log({
+                "event": "rollback_budget_exhausted",
+                "step": step,
+                "used": self.used,
+                "budget": self.cfg.rollback_budget,
+            })
+            return "exhausted"
+
+        # An in-flight async save may be writing the poisoned state; let it
+        # land (and surface its errors) before we pick a restore target. The
+        # same-step deepening below covers the poisoned-checkpoint case.
+        try:
+            trainer.join_pending_save()
+        except RuntimeError:
+            self._log({"event": "async_checkpoint_failed", "step": step})
+        trainer._drop_feed()
+
+        from pretraining_llm_tpu.training import checkpoint as ckpt
+
+        directory = trainer.config.train.checkpoint_dir
+        template = trainer._state_template()
+        # Same-candidate rule: if the newest checkpoint is the one we already
+        # restored and the anomaly came back, restoring it again is futile —
+        # the poison predates it. Dig strictly earlier.
+        newest = max(ckpt._list_steps(directory), default=None)
+        before = newest + 1 if newest is not None else None
+        if newest is not None and newest == self._last_restored:
+            before = newest
+        restored = ckpt.restore_latest(
+            directory,
+            template,
+            before_step=before,
+            loader=trainer._checkpoint_loader,
+            on_skip=lambda path, e: self._log({
+                "event": "checkpoint_skipped",
+                "path": path,
+                "error": repr(e)[:200],
+            }),
+        )
+        if restored is None:
+            self._log({"event": "rollback_no_checkpoint", "step": step})
+            return "no_checkpoint"
+
+        state, extra, restored_step = restored
+        trainer._adopt_restored(state, extra)
+        skip = max(0, step - restored_step) + self.cfg.skip_batches
+        trainer._skip_batches(skip)
+
+        self.used += 1
+        self._last_restored = restored_step
+        self._cooldown_until = restored_step + self.cfg.cooldown_steps
+        self._log({
+            "event": "rollback",
+            "kind": anomaly.kind,
+            "from_step": step,
+            "to_step": restored_step,
+            "skipped_batches": skip,
+            "budget_left": self.cfg.rollback_budget - self.used,
+        })
+        return "rolled_back"
+
+    @property
+    def last_restored(self) -> Optional[int]:
+        return self._last_restored

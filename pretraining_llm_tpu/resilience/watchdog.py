@@ -1,0 +1,124 @@
+"""Host-side hung-step watchdog.
+
+The failure class the round-5 campaign actually hit: a chip wedge (the
+``save_attn_res`` Pallas hang) blocks the host thread inside a device sync
+forever — no exception, no SIGTERM, nothing for the trainer's failure path
+to catch. The only recovery is out-of-process: a watchdog thread that
+notices steps stopped completing, preserves what it can, and exits with a
+distinct return code so the supervisor knows to relaunch.
+
+On timeout the watchdog, in order:
+  1. dumps every thread's stack to stderr (faulthandler — the wedge's
+     location is the single most valuable debugging artifact);
+  2. runs the ``on_timeout`` callback (the trainer passes its emergency
+     checkpoint save) under a try/except — best-effort by construction,
+     since the main thread may hold arbitrary locks;
+  3. ``os._exit(EXIT_WEDGED)``. ``_exit``, not ``sys.exit``: a raised
+     SystemExit in a daemon thread is swallowed, and atexit handlers may
+     themselves block on the wedged device.
+
+Arm it AFTER the first completed step so compile time never counts against
+the timeout, then call ``heartbeat()`` every completed step.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from pretraining_llm_tpu.resilience import EXIT_WEDGED
+
+
+class StepWatchdog:
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        on_timeout: Optional[Callable[[], None]] = None,
+        logger: Any = None,
+        exit_code: int = EXIT_WEDGED,
+        exit_fn: Callable[[int], None] = os._exit,
+    ) -> None:
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.on_timeout = on_timeout
+        self.logger = logger
+        self.exit_code = exit_code
+        self._exit = exit_fn  # injectable so tests can observe instead of die
+        self._last_beat: Optional[float] = None  # None = not armed yet
+        self._stopped = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def heartbeat(self) -> None:
+        """A step completed. First call arms the watchdog."""
+        self._last_beat = time.monotonic()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    # -- monitor thread ------------------------------------------------
+
+    def _run(self) -> None:
+        poll = min(self.timeout_s / 4.0, 1.0)
+        while not self._stopped.wait(poll):
+            if self._last_beat is None:
+                continue  # not armed: still compiling / first step in flight
+            stalled = time.monotonic() - self._last_beat
+            if stalled > self.timeout_s:
+                self._fire(stalled)
+                return
+
+    def _fire(self, stalled: float) -> None:
+        self._fired = True
+        if self.logger is not None:
+            try:
+                self.logger.log({
+                    "event": "watchdog_timeout",
+                    "stalled_s": round(stalled, 2),
+                    "timeout_s": self.timeout_s,
+                })
+            except Exception:
+                pass
+        try:
+            sys.stderr.write(
+                f"\n=== step watchdog: no completed step in {stalled:.1f}s "
+                f"(timeout {self.timeout_s:.1f}s); all thread stacks: ===\n"
+            )
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            sys.stderr.flush()
+        except Exception:
+            pass
+        if self.on_timeout is not None:
+            try:
+                self.on_timeout()
+            except Exception as e:
+                if self.logger is not None:
+                    try:
+                        self.logger.log({
+                            "event": "emergency_save_failed",
+                            "error": repr(e)[:200],
+                        })
+                    except Exception:
+                        pass
+        self._exit(self.exit_code)

@@ -168,6 +168,63 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return os.path.join(directory, f"step-{max(steps)}")
 
 
+def gc_partial(directory: str) -> List[str]:
+    """Remove leftover ``tmp-<step>`` dirs (partial writes by a killed run).
+
+    The atomic-publish protocol makes these invisible to ``latest_checkpoint``
+    already; GC keeps them from accumulating and from confusing operators
+    inspecting the directory. Call from process 0 only (the writer of the
+    shared dir). Returns the removed names."""
+    if not os.path.isdir(directory):
+        return []
+    removed = []
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("tmp-"):
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+            removed.append(name)
+    return removed
+
+
+def restore_latest(
+    directory: str,
+    state_template: Any,
+    *,
+    before_step: Optional[int] = None,
+    loader: Optional[Any] = None,
+    on_skip: Optional[Any] = None,
+) -> Optional[Tuple[Any, Dict[str, Any], int]]:
+    """Restore the newest LOADABLE checkpoint, falling back past corrupt ones.
+
+    The recovery-path counterpart of ``load_checkpoint``: a preempted or
+    wedged run can leave a ``tmp-<step>`` partial (GC'd here), and disk/
+    backend faults can truncate a leaf or lose ``metadata.json`` inside a
+    published step dir. Steps are tried newest-first (optionally only those
+    ``< before_step`` — the rollback manager uses this to dig past a
+    poisoned checkpoint); each failure is reported via ``on_skip(path, exc)``
+    and the next-older step is tried. Returns ``(state, extra, step)`` or
+    None when the directory holds no loadable checkpoint at all.
+
+    ``loader(path, template)`` defaults to ``load_checkpoint``; the trainer
+    passes a wrapper adding its ema-compat fallback.
+    """
+    if jax.process_index() == 0:
+        gc_partial(directory)
+    load = loader or load_checkpoint
+    steps = sorted(_list_steps(directory), reverse=True)
+    if before_step is not None:
+        steps = [s for s in steps if s < before_step]
+    for step in steps:
+        path = os.path.join(directory, f"step-{step}")
+        try:
+            state, extra = load(path, state_template)
+        except Exception as e:  # corrupt/truncated/missing pieces: fall back
+            if on_skip is not None:
+                on_skip(path, e)
+            continue
+        return state, extra, step
+    return None
+
+
 def _load_leaf(path: str, entry: Dict[str, Any]) -> np.ndarray:
     name = entry["name"]
     if not entry.get("sharded"):

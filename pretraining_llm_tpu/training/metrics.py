@@ -20,12 +20,26 @@ from pretraining_llm_tpu.utils.hardware import device_peak_flops
 
 
 class MetricsLogger:
+    """JSONL + stdout sink. Context manager; ``close`` is idempotent and the
+    JSONL file transparently reopens (append) on the next ``log`` — so the
+    trainer can close the fd on every train() exit path while the same
+    logger keeps working across repeated train() calls on one Trainer."""
+
     def __init__(self, jsonl_path: str = "", stream: Optional[TextIO] = None) -> None:
         self.stream = stream or sys.stdout
+        self._path = jsonl_path
         self._file = open(jsonl_path, "a") if jsonl_path else None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close()
 
     def log(self, record: Dict[str, Any]) -> None:
         record = {k: (float(v) if hasattr(v, "item") else v) for k, v in record.items()}
+        if self._file is None and self._path:
+            self._file = open(self._path, "a")
         if self._file is not None:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
@@ -40,6 +54,7 @@ class MetricsLogger:
     def close(self) -> None:
         if self._file is not None:
             self._file.close()
+            self._file = None
 
 
 class Throughput:

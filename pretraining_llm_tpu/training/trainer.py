@@ -58,6 +58,9 @@ class Trainer:
         self.mesh = mesh if mesh is not None else (
             build_mesh(config.mesh) if needs_mesh(config.mesh) else None
         )
+        # Own the logger's lifecycle only if we created it: train() closes an
+        # owned logger's JSONL fd on every exit path (it reopens on demand).
+        self._owns_logger = logger is None
         self.logger = logger or MetricsLogger(config.train.metrics_path)
         self.step_fn = ts.build_train_step(config, self.mesh)
         self.eval_loop = ts.build_eval_loop(config, self.mesh)
@@ -131,51 +134,46 @@ class Trainer:
             self._put_eval = self._put
 
         # --- state: fresh init or resume-from-latest ----------------------
+        # Resume goes through checkpoint.restore_latest: leftover tmp-<step>
+        # partials are GC'd and a corrupt newest checkpoint (truncated leaf,
+        # missing metadata) falls back to the previous good step instead of
+        # dying. If step dirs exist but NONE load, refuse to silently
+        # reinitialize — that would look like a fresh run to the supervisor
+        # and quietly lose the whole training lineage.
         self.start_step = 0
-        latest = ckpt.latest_checkpoint(tcfg.checkpoint_dir) if resume else None
-        if latest is not None:
-            # Structure/shape template without materializing a throwaway init.
-            template = jax.eval_shape(
-                lambda: ts.init_train_state(config, jax.random.key(tcfg.seed))
+        restored = None
+        if resume and ckpt.latest_checkpoint(tcfg.checkpoint_dir) is not None:
+            restored = ckpt.restore_latest(
+                tcfg.checkpoint_dir,
+                self._state_template(),
+                loader=self._checkpoint_loader,
+                on_skip=lambda path, e: self.logger.log({
+                    "event": "checkpoint_skipped",
+                    "path": path,
+                    "error": repr(e)[:200],
+                }),
             )
-            try:
-                state, extra = ckpt.load_checkpoint(latest, template)
-            except ValueError as e:
-                if "ema" in template and "missing leaves: ['ema" in str(e):
-                    # ema_decay was turned ON mid-run: the old checkpoints
-                    # carry no shadow. Load without it and seed the shadow
-                    # from the restored params (exactly what a fresh
-                    # init_train_state does) instead of dying.
-                    no_ema = {k: v for k, v in template.items() if k != "ema"}
-                    state, extra = ckpt.load_checkpoint(latest, no_ema)
-                    state["ema"] = jax.tree.map(
-                        lambda p: np.array(p, dtype=np.float32, copy=True),
-                        state["params"],
-                    )
-                    self.logger.log({
-                        "event": "ema_seeded_from_params", "from": latest,
-                    })
-                else:
-                    raise
-            # Migration guard: checkpoints written by this trainer are always
-            # depth-major (save de-interleaves a baked state); a checkpoint
-            # carrying the interleaved layout (e.g. a raw dump of a baked
-            # state by external tooling) is converted back to canonical here
-            # before shard_train_state re-bakes for the active mesh.
-            if extra.get("block_layout", "depth_major") == "interleaved":
-                state = ts.bake_state_layout(state, config, forward=False)
-            self.start_step = int(extra.get("step", 0))
-            rng_state = extra.get("data_rng")
-            if rng_state is not None and hasattr(self.train_iterator, "set_state"):
-                self.train_iterator.set_state(rng_state)
-            self.logger.log({"event": "resumed", "from": latest, "step": self.start_step})
+            if restored is None:
+                raise RuntimeError(
+                    f"checkpoint dir {tcfg.checkpoint_dir!r} contains step "
+                    "dirs but none are loadable; refusing to reinitialize "
+                    "over a corrupt lineage (pass resume=False to override)"
+                )
+        if restored is not None:
+            state, extra, restored_step = restored
+            self.start_step = self._adopt_restored(state, extra)
+            self.logger.log({
+                "event": "resumed",
+                "from": os.path.join(tcfg.checkpoint_dir, f"step-{restored_step}"),
+                "step": self.start_step,
+            })
         else:
             state = ts.init_train_state(config, jax.random.key(tcfg.seed))
-        if self.mesh is not None:
-            state = ts.shard_train_state(state, self.mesh, config)
-        else:
-            state = jax.device_put(state)
-        self.state = state
+            if self.mesh is not None:
+                state = ts.shard_train_state(state, self.mesh, config)
+            else:
+                state = jax.device_put(state)
+            self.state = state
         # Input-pipeline overlap (VERDICT r2 next #8): sampling + H2D run on
         # a background thread, `data.prefetch` batches deep. Exact resume is
         # preserved because the prefetcher checkpoints the CONSUMED-batch RNG
@@ -187,6 +185,13 @@ class Trainer:
         # deliver SIGTERM); the loop checkpoints and stops at the next step
         # boundary instead of dying mid-step.
         self._stop_requested = False
+        # Why the last train() call ended: "completed" | "preempted" |
+        # "anomaly_budget" | "anomaly_no_checkpoint". scripts/train.py maps
+        # this to the resilience return-code contract for the supervisor.
+        self.exit_reason = "completed"
+        # Last step whose state is fully materialized — what the watchdog's
+        # emergency checkpoint persists.
+        self._completed_step = self.start_step
 
     def _make_iterator(self, path: str, seed: int):
         """File iterator: native C++ gatherer when built, numpy otherwise.
@@ -220,6 +225,69 @@ class Trainer:
             shard_index=jax.process_index(),
             shard_count=jax.process_count(),
         )
+
+    # --- restore / rollback plumbing ----------------------------------
+    def _state_template(self):
+        """Structure/shape template without materializing a throwaway init."""
+        return jax.eval_shape(
+            lambda: ts.init_train_state(self.config, jax.random.key(self.config.train.seed))
+        )
+
+    def _checkpoint_loader(self, path: str, template: Any):
+        """load_checkpoint plus the ema-compat fallback (used both at resume
+        and by rollback's restore_latest)."""
+        try:
+            return ckpt.load_checkpoint(path, template)
+        except ValueError as e:
+            if "ema" in template and "missing leaves: ['ema" in str(e):
+                # ema_decay was turned ON mid-run: the old checkpoints
+                # carry no shadow. Load without it and seed the shadow
+                # from the restored params (exactly what a fresh
+                # init_train_state does) instead of dying.
+                no_ema = {k: v for k, v in template.items() if k != "ema"}
+                state, extra = ckpt.load_checkpoint(path, no_ema)
+                state["ema"] = jax.tree.map(
+                    lambda p: np.array(p, dtype=np.float32, copy=True),
+                    state["params"],
+                )
+                self.logger.log({"event": "ema_seeded_from_params", "from": path})
+                return state, extra
+            raise
+
+    def _adopt_restored(self, state: Any, extra: Dict[str, Any]) -> int:
+        """Install a loaded checkpoint as the live train state (sharded for
+        the active mesh) + data-RNG frontier. Returns the restored step."""
+        # Migration guard: checkpoints written by this trainer are always
+        # depth-major (save de-interleaves a baked state); a checkpoint
+        # carrying the interleaved layout (e.g. a raw dump of a baked
+        # state by external tooling) is converted back to canonical here
+        # before shard_train_state re-bakes for the active mesh.
+        if extra.get("block_layout", "depth_major") == "interleaved":
+            state = ts.bake_state_layout(state, self.config, forward=False)
+        if self.mesh is not None:
+            state = ts.shard_train_state(state, self.mesh, self.config)
+        else:
+            state = jax.device_put(state)
+        self.state = state
+        rng_state = extra.get("data_rng")
+        if rng_state is not None and hasattr(self.train_iterator, "set_state"):
+            self.train_iterator.set_state(rng_state)
+        return int(extra.get("step", 0))
+
+    def _drop_feed(self) -> None:
+        """Close the prefetch feed WITHOUT rewinding the source iterator —
+        rollback callers overwrite its RNG state right after (so the queued
+        poison-window batches are simply discarded). The close() join makes
+        the subsequent set_state safe against a mid-draw worker."""
+        if self._feed is not None:
+            self._feed.close()
+            self._feed = None
+
+    def _skip_batches(self, n: int) -> None:
+        """Advance the data-RNG frontier by drawing and discarding n batches
+        (host-side sampling only — nothing is transferred to devices)."""
+        for _ in range(n):
+            next(self.train_iterator)
 
     # ------------------------------------------------------------------
     def _fresh_val_iterator(self):
@@ -337,6 +405,49 @@ class Trainer:
                 self._pending_save_error = None
                 raise RuntimeError("async checkpoint write failed") from err
 
+    # Upper bound on the watchdog's emergency checkpoint write. On a real
+    # chip wedge the device_get inside save can block behind the wedged
+    # step; the watchdog must still exit EXIT_WEDGED rather than hang with
+    # the run it is supposed to be guarding.
+    EMERGENCY_SAVE_TIMEOUT_S = 60.0
+
+    def _emergency_save(self) -> None:
+        """Watchdog-thread best effort: persist the last COMPLETED step before
+        the process exits EXIT_WEDGED. self.state is that step's output and
+        still valid; the main thread is wedged, so everything here must be
+        bounded — a stalled write is abandoned (atomic publish means an
+        abandoned tmp-<step> is invisible and GC'd on the next restore).
+        Multi-host saves barrier across processes and a wedge is usually
+        collective, so only single-process runs attempt the save."""
+        if jax.process_count() > 1:
+            return
+        pending = getattr(self, "_pending_save", None)
+        if pending is not None and pending.is_alive():
+            pending.join(timeout=10.0)
+            if pending.is_alive():
+                return  # async writer wedged too; two writers would tear the dir
+        self._pending_save = None
+        self._pending_save_error = None
+        step = self._completed_step
+        self.logger.log({"event": "emergency_checkpoint", "step": step})
+        import threading
+
+        done = threading.Event()
+
+        def write() -> None:
+            try:
+                self.save(step, sync=True)
+            except Exception as e:
+                self.logger.log({
+                    "event": "emergency_save_failed", "error": repr(e)[:200],
+                })
+            finally:
+                done.set()
+
+        threading.Thread(target=write, daemon=True).start()
+        if not done.wait(timeout=self.EMERGENCY_SAVE_TIMEOUT_S):
+            self.logger.log({"event": "emergency_checkpoint_stalled", "step": step})
+
     # ------------------------------------------------------------------
     _NOT_INSTALLED = object()  # sentinel: handler could not be installed
 
@@ -370,51 +481,109 @@ class Trainer:
 
     def train(self, steps: Optional[int] = None) -> Dict[str, float]:
         tcfg = self.config.train
+        rcfg = self.config.resilience
         total = steps if steps is not None else tcfg.train_steps
         tokens_per_step = tcfg.batch_size * self.config.model.context_length
         is_host0 = jax.process_index() == 0
         self._stop_requested = False  # a prior run's SIGTERM must not persist
+        self.exit_reason = "completed"
         prev_sigterm = self._install_preemption_handler()
 
         from pretraining_llm_tpu.utils.profiling import StepProfiler
 
         profiler = StepProfiler(tcfg.profile_dir, tcfg.profile_start, tcfg.profile_steps)
 
-        # Sampling + device_put run `data.prefetch` batches ahead on a
-        # worker thread; the checkpointed data-RNG state remains exactly the
-        # consumed-batch frontier (DevicePrefetcher.state), so resume is
-        # still bit-exact. prefetch=0 keeps the fully synchronous loop.
-        if self._feed is None and self.config.data.prefetch > 0:
-            self._feed = data_loader.DevicePrefetcher(
-                self.train_iterator, self._put, self.config.data.prefetch
+        # --- resilience wiring (resilience/): all host-side, every piece a
+        # no-op unless its config knob is set. Anomaly decisions need no
+        # cross-host sync: the observed metrics are replicated global-batch
+        # scalars, so every process detects (and rolls back) identically.
+        detector = rollback_mgr = faults = watchdog = None
+        event_log = self.logger if is_host0 else None
+        if rcfg.anomaly_detection:
+            from pretraining_llm_tpu.resilience.anomaly import AnomalyDetector
+            from pretraining_llm_tpu.resilience.rollback import RollbackManager
+
+            detector = AnomalyDetector(rcfg)
+            rollback_mgr = RollbackManager(rcfg, logger=event_log)
+        if rcfg.faults:
+            from pretraining_llm_tpu.resilience.faults import FaultInjector
+
+            faults = FaultInjector(
+                rcfg.faults, start_step=self.start_step, logger=event_log
             )
+        if rcfg.watchdog_timeout_s > 0:
+            from pretraining_llm_tpu.resilience.watchdog import StepWatchdog
+
+            watchdog = StepWatchdog(
+                rcfg.watchdog_timeout_s,
+                on_timeout=self._emergency_save,
+                logger=event_log,
+            ).start()
+
         last: Dict[str, float] = {}
         step = self.start_step
         preempted = False
         try:
-            for step in range(self.start_step, total):
+            while step < total:
+                # Sampling + device_put run `data.prefetch` batches ahead on
+                # a worker thread; the checkpointed data-RNG state remains
+                # exactly the consumed-batch frontier (DevicePrefetcher
+                # .state), so resume is still bit-exact. Built inside the
+                # loop so a rollback's _drop_feed gets a fresh feed on the
+                # rewound iterator. prefetch=0 keeps the synchronous loop.
+                if self._feed is None and self.config.data.prefetch > 0:
+                    self._feed = data_loader.DevicePrefetcher(
+                        self.train_iterator, self._put, self.config.data.prefetch
+                    )
                 profiler.step(step)
+                if faults is not None:
+                    faults.maybe_fire(step, self)
                 if self._feed is not None:
                     batch = next(self._feed)
                 else:
                     batch = self._put(next(self.train_iterator))
                 self.state, metrics = self.step_fn(self.state, batch)
                 self.throughput.tick(tokens_per_step)
+                step += 1
+                self._completed_step = step
+                if watchdog is not None:
+                    watchdog.heartbeat()  # first beat arms it: compile excluded
 
-                at_log = (step + 1) % tcfg.log_interval == 0 or step + 1 == total
+                at_log = step % tcfg.log_interval == 0 or step == total
                 if at_log and self._stop_synced():
                     preempted = True
+                    self.exit_reason = "preempted"
                     if is_host0:
-                        self.logger.log({"event": "preempted", "step": step + 1})
-                    self.save(step + 1, sync=True)
+                        self.logger.log({"event": "preempted", "step": step})
+                    self.save(step, sync=True)
                     break
+                off_path = False
                 if at_log:
                     last = {k: float(v) for k, v in metrics.items()}  # device sync
                     last.update(self.throughput.window())
                     if is_host0:
-                        self.logger.log({"step": step + 1, **last})
-                off_path = False
-                if tcfg.eval_interval > 0 and (step + 1) % tcfg.eval_interval == 0:
+                        self.logger.log({"step": step, **last})
+                    if detector is not None:
+                        anomaly = detector.observe(step, last)
+                        if anomaly is not None:
+                            if is_host0:
+                                self.logger.log(anomaly.as_event())
+                            outcome = rollback_mgr.handle(self, anomaly)
+                            if outcome == "rolled_back":
+                                detector.reset()
+                                step = rollback_mgr.last_restored
+                                self._completed_step = step
+                                self.throughput.reset_clock()
+                                continue
+                            if outcome in ("exhausted", "no_checkpoint"):
+                                self.exit_reason = (
+                                    "anomaly_budget"
+                                    if outcome == "exhausted"
+                                    else "anomaly_no_checkpoint"
+                                )
+                                break
+                            # "suppressed": inside the cooldown; keep going.
+                if tcfg.eval_interval > 0 and step % tcfg.eval_interval == 0:
                     val_loss = self.evaluate()
                     # Standard derived views of the same number: perplexity
                     # and bits-per-token (nats -> bits) for cross-run and
@@ -429,12 +598,12 @@ class Trainer:
                     last.update(eval_metrics)
                     off_path = True
                     if is_host0:
-                        self.logger.log({"step": step + 1, **eval_metrics})
-                if tcfg.checkpoint_interval > 0 and (step + 1) % tcfg.checkpoint_interval == 0:
+                        self.logger.log({"step": step, **eval_metrics})
+                if tcfg.checkpoint_interval > 0 and step % tcfg.checkpoint_interval == 0:
                     off_path = True
                     # ALL processes: each writes its own shards; the barrier
                     # and metadata gating are inside save_checkpoint.
-                    self.save(step + 1)
+                    self.save(step)
                 if off_path:
                     self.throughput.reset_clock()  # keep eval/ckpt time out of step_ms
         except Exception as e:
@@ -455,6 +624,10 @@ class Trainer:
             raise
         finally:
             profiler.close()
+            if watchdog is not None:
+                # Disarm BEFORE the exit-path joins below: a slow final
+                # checkpoint is not a wedged step.
+                watchdog.stop()
             if prev_sigterm is not Trainer._NOT_INSTALLED:
                 signal.signal(signal.SIGTERM, prev_sigterm)
             # Join the in-flight async write on EVERY exit path — incl.
@@ -502,6 +675,16 @@ class Trainer:
                     self.logger.log({"event": "async_checkpoint_failed", "step": step})
                 if not propagating:
                     raise
+            finally:
+                # Flush + release the JSONL fd on EVERY exit path (clean,
+                # preempted, rollback-budget, exception). Only a logger this
+                # Trainer created is closed — and MetricsLogger reopens on
+                # the next log(), so repeated train() calls keep working.
+                # getattr: tests swap in bare capture objects post-init.
+                if self._owns_logger:
+                    close = getattr(self.logger, "close", None)
+                    if close is not None:
+                        close()
 
         if preempted:
             return last  # already checkpointed at the stop step

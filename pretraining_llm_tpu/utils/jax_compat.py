@@ -1,0 +1,76 @@
+"""Shims over JAX API drift so the codebase runs on 0.4.x and 0.5+ installs.
+
+The code targets the modern surface (`jax.shard_map`, `jax.sharding
+.get_abstract_mesh`, `AxisType`); on older installs the same machinery lives
+under `jax.experimental.shard_map` with a different keyword spelling
+(`check_rep` / `auto` instead of `check_vma` / `axis_names`) and the abstract
+trace-context mesh is internal-only. Routing every call through this module
+keeps version probes out of model and parallelism code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+import jax
+
+_HAS_MODERN_SHARD_MAP = hasattr(jax, "shard_map")
+
+if not _HAS_MODERN_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None):
+    """`jax.shard_map` with the modern keyword surface on either JAX.
+
+    `axis_names` (modern: the axes the region is MANUAL over) maps to the
+    legacy `auto` keyword as its complement over the mesh's axes.
+    """
+    if _HAS_MODERN_SHARD_MAP:
+        kwargs = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kwargs,
+        )
+    kwargs = {}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _legacy_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kwargs,
+    )
+
+
+def get_abstract_mesh() -> Optional[Any]:
+    """The trace-context AbstractMesh, or None when unset/unavailable.
+
+    Modern JAX returns an empty AbstractMesh sentinel outside any context;
+    0.4.x keeps the context internal and stores a bare `()` when unset —
+    both normalize to None here so callers only branch on truthiness.
+    """
+    try:
+        context = jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        from jax._src import mesh as _mesh_internal
+
+        context = _mesh_internal.get_abstract_mesh()
+        if not isinstance(context, _mesh_internal.AbstractMesh):
+            return None
+    if context is None or getattr(context, "empty", False):
+        return None
+    return context
+
+
+def manual_axis_names(abstract_mesh: Any) -> Set[str]:
+    """Mesh axes the current trace context is Manual over; empty when the
+    install predates typed mesh axes (0.4.x: shard_map regions are manual
+    over every mapped axis, but the context doesn't record it)."""
+    axis_types = getattr(abstract_mesh, "axis_types", None)
+    axis_type_enum = getattr(jax.sharding, "AxisType", None)
+    if abstract_mesh is None or axis_types is None or axis_type_enum is None:
+        return set()
+    return {
+        name
+        for name, kind in zip(abstract_mesh.axis_names, axis_types)
+        if kind == axis_type_enum.Manual
+    }

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# CPU smoke gate: everything must at least compile, and the resilience +
+# checkpoint recovery paths must pass end-to-end (including the slow
+# subprocess drills the tier-1 `-m "not slow"` run excludes).
+#
+# Usage: bash scripts/ci_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q pretraining_llm_tpu scripts
+
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_resilience.py \
+    "tests/test_training.py::test_checkpoint_roundtrip_and_exact_resume" \
+    "tests/test_training.py::test_checkpoint_retention" \
+    "tests/test_training.py::test_checkpoint_sharded_leaf_reassembly" \
+    -q -p no:cacheprovider "$@"

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Bounded exponential-backoff relauncher for training runs.
+
+The out-of-process half of the resilience subsystem (see
+pretraining_llm_tpu/resilience/): the in-process machinery turns faults into
+distinct return codes + checkpoints, and this supervisor turns those codes
+into restart policy. Pure stdlib — it must stay importable and instant even
+when the JAX toolchain is wedged.
+
+Usage:
+    python scripts/supervisor.py [options] -- python scripts/train.py ...
+
+Everything after ``--`` is the child command, relaunched as-is (training
+resumes from the latest checkpoint by itself — resume-from-latest is the
+trainer's default).
+
+Return-code policy (the contract in resilience/__init__.py):
+  0    clean completion              -> exit 0.
+  43   EXIT_PREEMPTED (SIGTERM stop) -> relaunch immediately; preemptions
+       are routine and the checkpoint is already written. Capped by
+       --max-preemptions only as a runaway guard.
+  44   EXIT_ANOMALY (rollback budget exhausted / no checkpoint) -> exit 44.
+       An anomaly that survived N in-process rollbacks is systemic;
+       relaunching would burn the cluster on the same failure forever.
+  45   EXIT_WEDGED (watchdog: hung step) -> relaunch with backoff; counts
+       toward --max-restarts.
+  else crash                         -> relaunch with backoff; counts
+       toward --max-restarts.
+
+A child that ran longer than --healthy-secs before failing resets the
+failure count (standard supervisor pattern: a run that made hours of
+progress before a wedge should not inherit the backoff of a crash loop).
+The supervisor exits with the child's last return code when a budget is
+exhausted, so outer schedulers see the true failure class.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import subprocess
+import sys
+import time
+
+# Keep in sync with pretraining_llm_tpu/resilience/__init__.py — duplicated
+# here so the supervisor never imports the training package (or JAX).
+EXIT_PREEMPTED = 43
+EXIT_ANOMALY = 44
+EXIT_WEDGED = 45
+
+
+def _log(record: dict) -> None:
+    record = {"supervisor": True, "t": round(time.time(), 1), **record}
+    print(json.dumps(record), flush=True)
+
+
+def parse_args(argv):
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "--max-restarts", type=int, default=10,
+        help="failure-restart budget (wedges + crashes); exceeded -> give up",
+    )
+    parser.add_argument(
+        "--max-preemptions", type=int, default=1000,
+        help="runaway guard on immediate preemption relaunches",
+    )
+    parser.add_argument(
+        "--backoff-base", type=float, default=5.0,
+        help="first failure backoff in seconds (doubles per consecutive failure)",
+    )
+    parser.add_argument(
+        "--backoff-max", type=float, default=300.0,
+        help="backoff ceiling in seconds",
+    )
+    parser.add_argument(
+        "--healthy-secs", type=float, default=300.0,
+        help="a child surviving this long resets the failure count",
+    )
+    if "--" not in argv:
+        parser.error("missing '-- <command ...>' (the child command to supervise)")
+    split = argv.index("--")
+    args = parser.parse_args(argv[:split])
+    cmd = argv[split + 1:]
+    if not cmd:
+        parser.error("empty child command after '--'")
+    return args, cmd
+
+
+def supervise(args, cmd) -> int:
+    failures = 0
+    preemptions = 0
+    launches = 0
+    while True:
+        launches += 1
+        _log({"event": "launch", "attempt": launches, "cmd": cmd})
+        started = time.monotonic()
+        try:
+            rc = subprocess.call(cmd)
+        except KeyboardInterrupt:
+            _log({"event": "interrupted"})
+            return 130
+        elapsed = time.monotonic() - started
+        _log({"event": "exit", "rc": rc, "elapsed_s": round(elapsed, 1)})
+
+        if rc == 0:
+            return 0
+        if rc == EXIT_ANOMALY:
+            _log({"event": "fatal", "why": "anomaly budget exhausted; needs a human"})
+            return rc
+        if rc == EXIT_PREEMPTED:
+            preemptions += 1
+            if preemptions > args.max_preemptions:
+                _log({"event": "fatal", "why": "preemption budget exhausted"})
+                return rc
+            _log({"event": "relaunch", "why": "preempted", "backoff_s": 0})
+            continue
+
+        # Wedge or crash: exponential backoff, bounded budget.
+        if elapsed >= args.healthy_secs and failures:
+            _log({"event": "failure_count_reset", "elapsed_s": round(elapsed, 1)})
+            failures = 0
+        failures += 1
+        if failures > args.max_restarts:
+            _log({"event": "fatal", "why": "restart budget exhausted", "failures": failures - 1})
+            return rc
+        backoff = min(args.backoff_base * 2 ** (failures - 1), args.backoff_max)
+        why = "wedged" if rc == EXIT_WEDGED else f"crash rc={rc}"
+        _log({"event": "relaunch", "why": why, "failures": failures, "backoff_s": backoff})
+        time.sleep(backoff)
+
+
+def main() -> None:
+    # Pass SIGTERM through to the child via the default process-group
+    # delivery; the supervisor itself exits when the child's preemption
+    # budgeting says so, not on the signal.
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    args, cmd = parse_args(sys.argv[1:])
+    sys.exit(supervise(args, cmd))
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,20 @@ def main() -> None:
     trainer = Trainer(config, synthetic_data=(args.data == "synthetic"), resume=not args.no_resume)
     final = trainer.train(steps=args.steps)
     if jax.process_index() == 0:
-        print("final:", final)
+        print("final:", final, f"exit_reason={trainer.exit_reason}")
+    # Return-code contract for scripts/supervisor.py (see resilience/):
+    # preemption means "checkpointed, relaunch me"; an exhausted rollback
+    # budget means "systemic anomaly, stop relaunching". EXIT_WEDGED is
+    # raised by the watchdog itself via os._exit.
+    from pretraining_llm_tpu.resilience import EXIT_ANOMALY, EXIT_PREEMPTED
+
+    rc = {
+        "preempted": EXIT_PREEMPTED,
+        "anomaly_budget": EXIT_ANOMALY,
+        "anomaly_no_checkpoint": EXIT_ANOMALY,
+    }.get(trainer.exit_reason, 0)
+    if rc:
+        sys.exit(rc)
 
 
 def compile_only(config) -> None:
