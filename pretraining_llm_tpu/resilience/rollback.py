@@ -3,8 +3,9 @@
 The recovery policy that turns detection (anomaly.py) into continued
 training without a human in the loop:
 
-  restore   the newest loadable checkpoint (checkpoint.restore_latest — it
-            GC's partial tmp dirs and digs past truncated/torn step dirs).
+  restore   the newest loadable checkpoint (checkpoint.restore_latest_synced
+            — it GC's partial tmp dirs, digs past truncated/torn step dirs,
+            and on multi-host runs makes every process adopt the same step).
             If an anomaly recurs before any NEW checkpoint lands — i.e. the
             candidate equals the step we just restored — that checkpoint is
             itself suspect (poison crossed a save boundary), so the retry
@@ -91,7 +92,11 @@ class RollbackManager:
         before = newest + 1 if newest is not None else None
         if newest is not None and newest == self._last_restored:
             before = newest
-        restored = ckpt.restore_latest(
+        # _synced: on multi-host runs every process must restore the SAME
+        # step — a host-local load failure digging deeper on one host
+        # alone would leave divergent params/step/data-RNG and deadlock
+        # at the next collective.
+        restored = ckpt.restore_latest_synced(
             directory,
             template,
             before_step=before,

@@ -18,7 +18,9 @@ On timeout the watchdog, in order:
      themselves block on the wedged device.
 
 Arm it AFTER the first completed step so compile time never counts against
-the timeout, then call ``heartbeat()`` every completed step.
+the timeout, then call ``heartbeat()`` every completed step. Bracket
+known-long off-path work (eval, checkpoint saves, rollback restores) with
+``pause()``/``resume()`` — the timeout budgets a step, not a save.
 """
 
 from __future__ import annotations
@@ -51,6 +53,7 @@ class StepWatchdog:
         self.exit_code = exit_code
         self._exit = exit_fn  # injectable so tests can observe instead of die
         self._last_beat: Optional[float] = None  # None = not armed yet
+        self._was_armed_at_pause = False
         self._stopped = threading.Event()
         self._fired = False
         self._thread: Optional[threading.Thread] = None
@@ -67,6 +70,22 @@ class StepWatchdog:
     def heartbeat(self) -> None:
         """A step completed. First call arms the watchdog."""
         self._last_beat = time.monotonic()
+
+    def pause(self) -> None:
+        """Disarm while known-long off-path host work runs on the main
+        thread — eval, a checkpoint save, a rollback restore. The timeout
+        budgets a training STEP; charging it for a multi-minute save or
+        eval falsely fires EXIT_WEDGED on a healthy run (emergency-
+        checkpointing, killing the process, and burning the supervisor's
+        restart budget). ``resume`` re-arms with a fresh beat iff the
+        watchdog was armed when paused, so compile time stays excluded."""
+        self._was_armed_at_pause = self._last_beat is not None
+        self._last_beat = None
+
+    def resume(self) -> None:
+        if self._was_armed_at_pause:
+            self._was_armed_at_pause = False
+            self.heartbeat()
 
     def stop(self) -> None:
         self._stopped.set()

@@ -225,6 +225,62 @@ def restore_latest(
     return None
 
 
+def restore_latest_synced(
+    directory: str,
+    state_template: Any,
+    *,
+    before_step: Optional[int] = None,
+    loader: Optional[Any] = None,
+    on_skip: Optional[Any] = None,
+) -> Optional[Tuple[Any, Dict[str, Any], int]]:
+    """``restore_latest`` with cross-host agreement on the restore target.
+
+    Independent per-process ``restore_latest`` calls can diverge: a
+    host-LOCAL load failure (flaky disk, torn ``local.p<i>.json``) sends
+    only that host past the failing step to an older one, and the
+    processes then deadlock at the next collective with different params,
+    steps, and data-RNG frontiers. Here candidates are tried in lockstep:
+    every process loads the same step, the per-host success flags are
+    all-gathered, and a step is adopted only unanimously — any host
+    failing sends ALL hosts to the next-older candidate together.
+    Single-process this is exactly ``restore_latest``.
+
+    Candidate listing relies on the module's shared-directory assumption
+    (every process sees the same ``step-<N>`` dirs).
+    """
+    if jax.process_count() == 1:
+        return restore_latest(
+            directory, state_template,
+            before_step=before_step, loader=loader, on_skip=on_skip,
+        )
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == 0:
+        gc_partial(directory)
+    _barrier()  # no process may list the dir while the GC is mid-flight
+    load = loader or load_checkpoint
+    steps = sorted(_list_steps(directory), reverse=True)
+    if before_step is not None:
+        steps = [s for s in steps if s < before_step]
+    for step in steps:
+        path = os.path.join(directory, f"step-{step}")
+        result = None
+        try:
+            result = load(path, state_template)
+        except Exception as e:  # corrupt/truncated/missing pieces: vote no
+            if on_skip is not None:
+                on_skip(path, e)
+        oks = multihost_utils.process_allgather(
+            np.asarray([result is not None], dtype=np.bool_)
+        )
+        if bool(np.asarray(oks).all()):
+            state, extra = result
+            return state, extra, step
+        # Some host failed this step: nobody adopts it (a split restore
+        # deadlocks at the next collective); every host digs older.
+    return None
+
+
 def _load_leaf(path: str, entry: Dict[str, Any]) -> np.ndarray:
     name = entry["name"]
     if not entry.get("sharded"):

@@ -17,6 +17,7 @@ queue stays full between logs.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import signal
@@ -34,6 +35,22 @@ from pretraining_llm_tpu.parallel.sharding import batch_pspec
 from pretraining_llm_tpu.training import checkpoint as ckpt
 from pretraining_llm_tpu.training import train_step as ts
 from pretraining_llm_tpu.training.metrics import MetricsLogger, Throughput
+
+
+@contextlib.contextmanager
+def _watchdog_paused(watchdog):
+    """Disarm the step watchdog around off-path host work (eval, checkpoint
+    save, rollback restore): its timeout budgets a training step, and a save
+    or eval longer than the timeout would falsely fire EXIT_WEDGED on a
+    healthy run. No-op when the watchdog is off."""
+    if watchdog is None:
+        yield
+        return
+    watchdog.pause()
+    try:
+        yield
+    finally:
+        watchdog.resume()
 
 
 class Trainer:
@@ -143,7 +160,10 @@ class Trainer:
         self.start_step = 0
         restored = None
         if resume and ckpt.latest_checkpoint(tcfg.checkpoint_dir) is not None:
-            restored = ckpt.restore_latest(
+            # _synced: multi-host, all processes must adopt the SAME step —
+            # a host-local load failure digging deeper on one host alone
+            # would deadlock the first collective.
+            restored = ckpt.restore_latest_synced(
                 tcfg.checkpoint_dir,
                 self._state_template(),
                 loader=self._checkpoint_loader,
@@ -555,7 +575,8 @@ class Trainer:
                     self.exit_reason = "preempted"
                     if is_host0:
                         self.logger.log({"event": "preempted", "step": step})
-                    self.save(step, sync=True)
+                    with _watchdog_paused(watchdog):
+                        self.save(step, sync=True)
                     break
                 off_path = False
                 if at_log:
@@ -568,7 +589,8 @@ class Trainer:
                         if anomaly is not None:
                             if is_host0:
                                 self.logger.log(anomaly.as_event())
-                            outcome = rollback_mgr.handle(self, anomaly)
+                            with _watchdog_paused(watchdog):
+                                outcome = rollback_mgr.handle(self, anomaly)
                             if outcome == "rolled_back":
                                 detector.reset()
                                 step = rollback_mgr.last_restored
@@ -584,7 +606,8 @@ class Trainer:
                                 break
                             # "suppressed": inside the cooldown; keep going.
                 if tcfg.eval_interval > 0 and step % tcfg.eval_interval == 0:
-                    val_loss = self.evaluate()
+                    with _watchdog_paused(watchdog):
+                        val_loss = self.evaluate()
                     # Standard derived views of the same number: perplexity
                     # and bits-per-token (nats -> bits) for cross-run and
                     # cross-tokenizer comparison. 700 ~ float64 exp overflow;
@@ -603,7 +626,8 @@ class Trainer:
                     off_path = True
                     # ALL processes: each writes its own shards; the barrier
                     # and metadata gating are inside save_checkpoint.
-                    self.save(step)
+                    with _watchdog_paused(watchdog):
+                        self.save(step)
                 if off_path:
                     self.throughput.reset_clock()  # keep eval/ckpt time out of step_ms
         except Exception as e:
@@ -617,7 +641,8 @@ class Trainer:
             if is_host0:
                 self.logger.log({"event": "failure", "step": step, "error": repr(e)[:200]})
             try:
-                self.save(step, sync=True)
+                with _watchdog_paused(watchdog):
+                    self.save(step, sync=True)
             except Exception as save_err:  # keep the original error primary
                 if is_host0:
                     self.logger.log({"event": "emergency_save_failed", "error": repr(save_err)[:200]})
@@ -688,8 +713,15 @@ class Trainer:
 
         if preempted:
             return last  # already checkpointed at the stop step
-        if tcfg.save_final and (
-            tcfg.checkpoint_interval <= 0 or total % tcfg.checkpoint_interval != 0
+        # Final save only for a genuinely completed run, labeled with the
+        # step actually reached. After an anomaly break the live state is
+        # the poisoned (possibly NaN) one; persisting it — as step-<total>
+        # no less, mislabeled and newest in the dir — would hand any later
+        # resume corrupted params with a desynced data-RNG frontier.
+        if (
+            tcfg.save_final
+            and self.exit_reason == "completed"
+            and (tcfg.checkpoint_interval <= 0 or step % tcfg.checkpoint_interval != 0)
         ):
-            self.save(total, sync=True)
+            self.save(step, sync=True)
         return last

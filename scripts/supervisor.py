@@ -27,11 +27,21 @@ Return-code policy (the contract in resilience/__init__.py):
   else crash                         -> relaunch with backoff; counts
        toward --max-restarts.
 
-A child that ran longer than --healthy-secs before failing resets the
+A child that ran longer than --healthy-secs before CRASHING resets the
 failure count (standard supervisor pattern: a run that made hours of
-progress before a wedge should not inherit the backoff of a crash loop).
-The supervisor exits with the child's last return code when a budget is
-exhausted, so outer schedulers see the true failure class.
+progress should not inherit the backoff of a crash loop). EXIT_WEDGED
+never resets it: a wedged child's lifetime includes the full watchdog
+timeout of dead hang, so wall-clock says nothing about progress — and a
+watchdog timeout >= --healthy-secs would otherwise relaunch a
+permanently wedged run forever. The supervisor exits with the child's
+last return code when a budget is exhausted, so outer schedulers see the
+true failure class.
+
+SIGTERM to the supervisor is forwarded to the child; once the child
+exits, the supervisor surfaces its return code WITHOUT relaunching — a
+terminated supervisor has no business restarting work. (Process-group
+delivery still works too: the child's own SIGTERM handler checkpoints
+and exits EXIT_PREEMPTED either way.)
 """
 
 from __future__ import annotations
@@ -77,7 +87,9 @@ def parse_args(argv):
     )
     parser.add_argument(
         "--healthy-secs", type=float, default=300.0,
-        help="a child surviving this long resets the failure count",
+        help="a child surviving this long before a CRASH resets the failure "
+        "count (wedges never reset it: their lifetime includes the whole "
+        "watchdog timeout spent hung)",
     )
     if "--" not in argv:
         parser.error("missing '-- <command ...>' (the child command to supervise)")
@@ -93,50 +105,92 @@ def supervise(args, cmd) -> int:
     failures = 0
     preemptions = 0
     launches = 0
-    while True:
-        launches += 1
-        _log({"event": "launch", "attempt": launches, "cmd": cmd})
-        started = time.monotonic()
-        try:
-            rc = subprocess.call(cmd)
-        except KeyboardInterrupt:
-            _log({"event": "interrupted"})
-            return 130
-        elapsed = time.monotonic() - started
-        _log({"event": "exit", "rc": rc, "elapsed_s": round(elapsed, 1)})
+    # SIGTERM handling: a TERM delivered to the supervisor ALONE (not the
+    # whole process group) must not kill it outright — that would orphan
+    # the training child and lose the EXIT_PREEMPTED relaunch contract.
+    # The handler forwards the signal to the child; the loop then waits
+    # for the child's exit and surfaces its return code without
+    # relaunching.
+    state = {"child": None, "term": False}
 
-        if rc == 0:
-            return 0
-        if rc == EXIT_ANOMALY:
-            _log({"event": "fatal", "why": "anomaly budget exhausted; needs a human"})
-            return rc
-        if rc == EXIT_PREEMPTED:
-            preemptions += 1
-            if preemptions > args.max_preemptions:
-                _log({"event": "fatal", "why": "preemption budget exhausted"})
+    def _on_term(signum, frame):  # noqa: ARG001 — signal API shape
+        state["term"] = True
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signal.SIGTERM)
+            except OSError:
+                pass  # child exited between poll and send
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # non-main thread (tests): run without forwarding
+        prev_term = None
+    try:
+        while True:
+            launches += 1
+            _log({"event": "launch", "attempt": launches, "cmd": cmd})
+            started = time.monotonic()
+            try:
+                child = subprocess.Popen(cmd)
+                state["child"] = child
+                if state["term"]:  # TERM raced the launch: forward now
+                    child.send_signal(signal.SIGTERM)
+                rc = child.wait()
+            except KeyboardInterrupt:
+                _log({"event": "interrupted"})
+                child = state["child"]
+                if child is not None and child.poll() is None:
+                    child.terminate()
+                    try:
+                        child.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        child.kill()
+                return 130
+            finally:
+                state["child"] = None
+            elapsed = time.monotonic() - started
+            _log({"event": "exit", "rc": rc, "elapsed_s": round(elapsed, 1)})
+
+            if state["term"]:
+                _log({"event": "terminated", "rc": rc})
                 return rc
-            _log({"event": "relaunch", "why": "preempted", "backoff_s": 0})
-            continue
+            if rc == 0:
+                return 0
+            if rc == EXIT_ANOMALY:
+                _log({"event": "fatal", "why": "anomaly budget exhausted; needs a human"})
+                return rc
+            if rc == EXIT_PREEMPTED:
+                preemptions += 1
+                if preemptions > args.max_preemptions:
+                    _log({"event": "fatal", "why": "preemption budget exhausted"})
+                    return rc
+                _log({"event": "relaunch", "why": "preempted", "backoff_s": 0})
+                continue
 
-        # Wedge or crash: exponential backoff, bounded budget.
-        if elapsed >= args.healthy_secs and failures:
-            _log({"event": "failure_count_reset", "elapsed_s": round(elapsed, 1)})
-            failures = 0
-        failures += 1
-        if failures > args.max_restarts:
-            _log({"event": "fatal", "why": "restart budget exhausted", "failures": failures - 1})
-            return rc
-        backoff = min(args.backoff_base * 2 ** (failures - 1), args.backoff_max)
-        why = "wedged" if rc == EXIT_WEDGED else f"crash rc={rc}"
-        _log({"event": "relaunch", "why": why, "failures": failures, "backoff_s": backoff})
-        time.sleep(backoff)
+            # Wedge or crash: exponential backoff, bounded budget. The
+            # health reset applies to crashes only — a wedged child's
+            # elapsed time includes watchdog_timeout_s of dead hang, so
+            # its lifetime measures nothing; letting wedges reset the
+            # counter would relaunch a permanently wedged run forever
+            # whenever the watchdog timeout exceeds --healthy-secs.
+            if rc != EXIT_WEDGED and elapsed >= args.healthy_secs and failures:
+                _log({"event": "failure_count_reset", "elapsed_s": round(elapsed, 1)})
+                failures = 0
+            failures += 1
+            if failures > args.max_restarts:
+                _log({"event": "fatal", "why": "restart budget exhausted", "failures": failures - 1})
+                return rc
+            backoff = min(args.backoff_base * 2 ** (failures - 1), args.backoff_max)
+            why = "wedged" if rc == EXIT_WEDGED else f"crash rc={rc}"
+            _log({"event": "relaunch", "why": why, "failures": failures, "backoff_s": backoff})
+            time.sleep(backoff)
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
 
 
 def main() -> None:
-    # Pass SIGTERM through to the child via the default process-group
-    # delivery; the supervisor itself exits when the child's preemption
-    # budgeting says so, not on the signal.
-    signal.signal(signal.SIGTERM, signal.SIG_DFL)
     args, cmd = parse_args(sys.argv[1:])
     sys.exit(supervise(args, cmd))
 

@@ -185,6 +185,42 @@ def test_watchdog_rejects_nonpositive_timeout():
         StepWatchdog(0.0)
 
 
+def test_watchdog_pause_covers_slow_offpath_work():
+    """A save/eval longer than the timeout must not fire while paused."""
+    codes = []
+    dog = StepWatchdog(0.2, exit_fn=codes.append).start()
+    try:
+        dog.heartbeat()  # arm
+        dog.pause()
+        time.sleep(0.6)  # "slow checkpoint save": 3x the timeout
+        assert not dog.fired and codes == []
+        dog.resume()
+        # resume() re-armed with a fresh beat: paused time isn't charged...
+        time.sleep(0.1)
+        assert not dog.fired
+        # ...but a genuine post-resume stall still fires.
+        deadline = time.monotonic() + 5.0
+        while not dog.fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert dog.fired and codes == [EXIT_WEDGED]
+    finally:
+        dog.stop()
+
+
+def test_watchdog_pause_before_arming_stays_disarmed():
+    """pause/resume before the first heartbeat must not arm the watchdog —
+    compile time stays excluded."""
+    codes = []
+    dog = StepWatchdog(0.2, exit_fn=codes.append).start()
+    try:
+        dog.pause()
+        dog.resume()
+        time.sleep(0.5)
+        assert not dog.fired and codes == []
+    finally:
+        dog.stop()
+
+
 # ------------------------------------------------- checkpoint corruption
 
 
@@ -262,15 +298,28 @@ def test_nan_injection_rolls_back_and_completes(tmp_path):
 
 
 def test_rollback_budget_exhaustion_stops_the_run(tmp_path):
+    # 14 steps (NOT a multiple of checkpoint_interval=4): with the run
+    # breaking early, an unguarded save_final would persist the poisoned
+    # (NaN) state as a mislabeled step-14 — newest in the dir, corrupting
+    # every later resume.
     cfg = _resilient_config(
         tmp_path,
-        **{"resilience.faults": "nan@9", "resilience.rollback_budget": 0},
+        **{
+            "train.train_steps": 14,
+            "resilience.faults": "nan@9",
+            "resilience.rollback_budget": 0,
+        },
     )
     trainer = Trainer(cfg, synthetic_data=True, resume=False)
     trainer.train()
     assert trainer.exit_reason == "anomaly_budget"
     kinds = [e.get("event") for e in _events(tmp_path)]
     assert "rollback_budget_exhausted" in kinds
+    # Newest on disk stays the last good in-loop save (step-8: the run
+    # broke at the step-10 log boundary), and resume lands on it.
+    assert max(ckpt._list_steps(cfg.train.checkpoint_dir)) == 8
+    t2 = Trainer(cfg, synthetic_data=True, resume=True)
+    assert t2.start_step == 8
 
 
 def test_anomaly_without_checkpoint_stops_the_run(tmp_path):
@@ -439,3 +488,59 @@ def test_supervisor_restart_budget(tmp_path):
         if l.startswith('{"supervisor"')
     ]
     assert [e["event"] for e in sup].count("launch") == 3  # 1 + 2 restarts
+
+
+def test_supervisor_wedge_never_resets_failure_count(tmp_path):
+    """EXIT_WEDGED must not reset the failure counter, however long the
+    child lived: a wedged child's lifetime includes the whole watchdog
+    timeout spent hung. --healthy-secs 0 makes every exit 'healthy' by
+    wall clock — with the reset applying to wedges this loops forever."""
+    cmd = [
+        sys.executable, SUPERVISOR, "--max-restarts", "2",
+        "--backoff-base", "0.05", "--healthy-secs", "0", "--",
+        sys.executable, "-c", f"import sys; sys.exit({EXIT_WEDGED})",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == EXIT_WEDGED
+    sup = [
+        json.loads(l) for l in proc.stdout.splitlines()
+        if l.startswith('{"supervisor"')
+    ]
+    events = [e["event"] for e in sup]
+    assert events.count("launch") == 3  # 1 + 2 restarts, then give up
+    assert "failure_count_reset" not in events
+
+
+def test_supervisor_forwards_sigterm_and_does_not_relaunch(tmp_path):
+    """A TERM delivered to the supervisor ALONE must reach the child (no
+    orphan) and surface the child's exit code without a relaunch."""
+    import signal as _signal
+
+    ready = tmp_path / "ready"
+    child = (
+        "import pathlib, signal, sys, time; "
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(43)); "
+        f"pathlib.Path({str(ready)!r}).write_text('r'); "
+        "time.sleep(120)"
+    )
+    cmd = [
+        sys.executable, SUPERVISOR, "--backoff-base", "0.05", "--",
+        sys.executable, "-c", child,
+    ]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    try:
+        deadline = time.monotonic() + 30.0
+        while not ready.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ready.exists(), "child never came up under the supervisor"
+        os.kill(proc.pid, _signal.SIGTERM)  # supervisor only, not the group
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 43  # the child's EXIT_PREEMPTED, surfaced
+    sup = [json.loads(l) for l in out.splitlines() if l.startswith('{"supervisor"')]
+    events = [e["event"] for e in sup]
+    assert events.count("launch") == 1  # terminated supervisors don't relaunch
+    assert "terminated" in events
