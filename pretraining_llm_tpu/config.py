@@ -686,6 +686,47 @@ class ResilienceConfig:
 
 
 # ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ObservabilityConfig:
+    """Run-wide telemetry knobs: events, spans, goodput export, HBM samples.
+
+    The in-memory pieces (event bus, goodput accounting, compile counting)
+    always run — they cost a few host-side dict updates per LOG BOUNDARY and
+    nothing per step. The fields here gate the file sinks and samplers. See
+    observability/ for the machinery and README "Observability" for usage.
+    """
+
+    # Run-event JSONL sink ("" = in-memory only). Events still reach the
+    # goodput accountant and the metrics logger's `goodput` field without it;
+    # the file is what scripts/obs_report.py and multi-run folds consume.
+    events_path: str = ""
+    # Chrome trace-event JSON of host-side spans, written at train() exit
+    # ("" = off). Open in Perfetto alongside the --profile xplane dumps.
+    spans_path: str = ""
+    # Prometheus textfile (node-exporter textfile-collector format),
+    # atomically rewritten at every log boundary and at run end ("" = off).
+    prometheus_path: str = ""
+    # Sample per-device HBM (Device.memory_stats) every N log boundaries
+    # (0 = off). A host-side allocator query — no device sync.
+    device_memory_interval: int = 0
+    # Count backend compiles via jax.monitoring; compiles after the first
+    # completed step become `recompile` events (a recompile storm shows up
+    # in the stream instead of only as lost MFU).
+    compile_telemetry: bool = True
+
+    def __post_init__(self) -> None:
+        if self.device_memory_interval < 0:
+            raise ValueError(
+                f"device_memory_interval must be >= 0, got "
+                f"{self.device_memory_interval}"
+            )
+
+
+# ---------------------------------------------------------------------------
 # Top-level
 # ---------------------------------------------------------------------------
 
@@ -697,6 +738,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     name: str = "custom"
 
     # NOTE: pipeline stage assignment (P('pipe', ...) on the stacked layer
@@ -718,7 +760,7 @@ class Config:
         for key, value in overrides.items():
             if "." in key:
                 section, fname = key.split(".", 1)
-                if section not in ("model", "mesh", "data", "train", "resilience"):
+                if section not in ("model", "mesh", "data", "train", "resilience", "obs"):
                     raise KeyError(f"unknown config section {section!r} in override {key!r}")
                 sections.setdefault(section, {})[fname] = value
             else:
@@ -750,6 +792,8 @@ class Config:
             train=TrainConfig(**raw["train"]),
             # Absent in checkpoints written before the resilience subsystem.
             resilience=ResilienceConfig(**raw.get("resilience", {})),
+            # Absent in checkpoints written before the observability subsystem.
+            obs=ObservabilityConfig(**raw.get("obs", {})),
             name=raw.get("name", "custom"),
         )
 

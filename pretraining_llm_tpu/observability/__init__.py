@@ -1,0 +1,58 @@
+"""Run-wide observability: events, spans, goodput, device/compile telemetry.
+
+The resilience subsystem (resilience/) made multi-day runs *survive* faults;
+this package makes the cost of surviving them visible. The flat metrics JSONL
+records loss and windowed MFU, but restarts, rollbacks, eval, checkpoint
+saves and recompiles are invisible in it — a 43.8%-MFU run and a run that
+spent 20% of wall-clock replaying a poison window look identical. The pieces:
+
+  - events.py  — structured, monotonic-timestamped run events (an EventBus
+                 with in-process subscribers and an optional JSONL sink);
+                 everything else in this package is a fold over the stream.
+  - spans.py   — nested host-side context-manager timers exporting Chrome
+                 trace-event JSON (open in Perfetto next to the XLA xplane
+                 dumps from ``--profile``). Recording is an append to a
+                 list — no device syncs, safe anywhere on the host.
+  - goodput.py — folds the event stream into a wall-clock decomposition
+                 (productive / replay / eval / checkpoint / restore / idle /
+                 other) and a single ``goodput`` fraction. Replay detection
+                 is a step high-water mark: re-run steps after a rollback
+                 are never productive time.
+  - device.py  — per-device HBM sampling (``Device.memory_stats()``) and a
+                 jax.monitoring compile listener that turns post-warmup
+                 backend compiles into ``recompile`` events, so a recompile
+                 storm shows up in the stream instead of only as lost MFU.
+  - export.py  — Prometheus textfile exporter (no server dependency): one
+                 atomic write per log boundary for a node-exporter-style
+                 scrape.
+
+scripts/obs_report.py is the offline half: metrics/events JSONL in, goodput
+breakdown + step-time histogram + event timeline out (run in CI over the
+smoke run, making the JSONL schema a checked contract).
+
+Everything here is host-side; recording between log boundaries performs no
+device→host syncs (tested). The hub below is what the trainer wires in.
+"""
+
+from pretraining_llm_tpu.observability.events import EVENT_KINDS, EventBus, sanitize_record
+from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
+from pretraining_llm_tpu.observability.spans import SpanRecorder, get_recorder, span
+from pretraining_llm_tpu.observability.export import prometheus_lines, write_textfile
+from pretraining_llm_tpu.observability.device import CompileWatcher, DeviceTelemetry
+from pretraining_llm_tpu.observability.hub import ObservabilityHub
+
+__all__ = [
+    "EVENT_KINDS",
+    "EventBus",
+    "sanitize_record",
+    "CATEGORIES",
+    "GoodputAccountant",
+    "SpanRecorder",
+    "get_recorder",
+    "span",
+    "prometheus_lines",
+    "write_textfile",
+    "CompileWatcher",
+    "DeviceTelemetry",
+    "ObservabilityHub",
+]

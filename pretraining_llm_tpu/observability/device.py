@@ -1,0 +1,182 @@
+"""Device + compile telemetry: HBM occupancy samples and recompile events.
+
+Two failure classes that are invisible in a loss/MFU stream:
+
+  - HBM creep: fragmentation or a leaked buffer marching ``bytes_in_use``
+    toward the ceiling until step N OOMs. ``DeviceTelemetry.sample`` reads
+    ``Device.memory_stats()`` — a host-side runtime query against the
+    allocator, NOT a device sync — per local device, at log boundaries only.
+    Backends without the API (CPU, some plugins) return None and the sample
+    is simply empty.
+
+  - recompile storms: a shape leak (python int step in the carry, a
+    data-dependent bucket) silently re-traces the step function, and MFU
+    craters with no event to explain it. ``CompileWatcher`` registers a
+    ``jax.monitoring`` duration listener for backend_compile events;
+    compiles before ``mark_warm()`` are the expected initial jit, every one
+    after becomes a ``recompile`` event on the bus with its compile seconds.
+
+jax imports live inside methods: this module (and the offline analyzer that
+imports the package) must stay importable without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+# Fired once per XLA backend compilation (probed on jax 0.4.x; the watcher
+# degrades to manual note_compile() calls if the name ever changes).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class DeviceTelemetry:
+    """Per-device memory sampling onto the event bus."""
+
+    def __init__(self, bus: Any = None) -> None:
+        self.bus = bus
+
+    def sample(self, step: Optional[int] = None) -> Dict[str, Dict[str, float]]:
+        """One ``memory_stats`` read per local device; emits a
+        ``device_memory`` event when any device reports. Returns
+        ``{device_label: stats}`` (empty when unsupported)."""
+        import jax
+
+        per_device: Dict[str, Dict[str, float]] = {}
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            keep = {
+                k: float(v)
+                for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                and isinstance(v, (int, float))
+            }
+            if keep:
+                per_device[f"{dev.platform}:{dev.id}"] = keep
+        if per_device and self.bus is not None:
+            worst = max(d.get("bytes_in_use", 0.0) for d in per_device.values())
+            self.bus.emit(
+                "device_memory",
+                step=step,
+                max_bytes_in_use=worst,
+                devices=per_device,
+            )
+        return per_device
+
+
+class CompileWatcher:
+    """Counts backend compiles/seconds; post-warmup compiles become events.
+
+    ``start`` registers the listener (idempotent), ``mark_warm`` draws the
+    line between expected first-compile and anomalous recompile, ``stop``
+    deactivates — unregistration uses a private jax hook when available,
+    but the listener also self-gates on ``_active`` so a stale registration
+    is harmless (jax has no public unregister).
+    """
+
+    def __init__(self, bus: Any = None) -> None:
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._active = False
+        self._registered = False
+        self._warm = False
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.recompiles = 0
+        self.recompile_s = 0.0
+        self._recompile_steps: List[Optional[int]] = []
+        self._current_step: Optional[int] = None
+        self._suppressed = 0
+
+    # -- wiring --------------------------------------------------------
+
+    def start(self) -> "CompileWatcher":
+        self._active = True
+        if self._registered:
+            return self
+        try:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_duration_secs_listener(self._listener)
+            self._registered = True
+        except Exception:
+            pass  # no monitoring API: note_compile() remains usable manually
+        return self
+
+    def stop(self) -> None:
+        self._active = False
+        if not self._registered:
+            return
+        try:
+            from jax._src import monitoring as _monitoring
+
+            _monitoring._unregister_event_duration_listener_by_callback(
+                self._listener
+            )
+            self._registered = False
+        except Exception:
+            pass  # private API moved: _active gate keeps the stale hook inert
+
+    def mark_warm(self, step: Optional[int] = None) -> None:
+        """The initial jit is done; further compiles are recompiles."""
+        self._warm = True
+        self._current_step = step
+
+    def at_step(self, step: int) -> None:
+        """Label subsequent recompile events with the loop's position
+        (called at log boundaries; compiles land between them)."""
+        self._current_step = step
+
+    @contextlib.contextmanager
+    def suppress(self) -> Iterator[None]:
+        """Treat compiles inside the block as expected (counted, no event).
+
+        Known-first-time off-path programs — the eval loop's jit at the
+        first eval boundary, a restore's device_put layout program — compile
+        AFTER the train step warmed up; without this they'd masquerade as
+        step-loop recompile storms. The hub wraps ``timed_event`` bodies in
+        it, so only compiles landing on the bare step path classify as
+        recompiles."""
+        with self._lock:
+            self._suppressed += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._suppressed -= 1
+
+    # -- accounting ----------------------------------------------------
+
+    def _listener(self, name: str, dur: float, **kw: Any) -> None:
+        if not self._active or name != _COMPILE_EVENT:
+            return
+        self.note_compile(dur)
+
+    def note_compile(self, dur_s: float) -> None:
+        """Record one backend compile (the listener body; public so tests
+        and monitoring-less environments can feed it directly)."""
+        with self._lock:
+            self.compiles += 1
+            self.compile_s += dur_s
+            if not self._warm or self._suppressed:
+                return
+            self.recompiles += 1
+            self.recompile_s += dur_s
+            step = self._current_step
+        if self.bus is not None:
+            self.bus.emit("recompile", step=step, dur_s=dur_s)
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_s": round(self.compile_s, 4),
+                "recompiles": self.recompiles,
+                "recompile_s": round(self.recompile_s, 4),
+            }

@@ -1,0 +1,141 @@
+"""Structured run events: the spine of the observability subsystem.
+
+An event is a flat JSON-serializable dict stamped with both clocks:
+
+  ``t_wall``  epoch seconds — comparable ACROSS processes and relaunches
+              (the goodput accountant orders multi-run streams by it);
+  ``t_mono``  monotonic seconds — immune to NTP steps WITHIN a process
+              (durations are always measured on this clock by the caller
+              and shipped as an explicit ``dur_s`` field);
+  ``seq``     per-bus emission counter — a total order for events landing
+              inside the same wall-clock tick.
+
+Duration-carrying events (``eval``, ``ckpt_save``, ``ckpt_restore``,
+``rollback``, ``step_window``) are emitted at the END of the activity they
+measure; the goodput fold relies on that convention.
+
+The bus is deliberately tiny: ``emit`` appends one line to an optional JSONL
+sink and calls the in-process subscribers (the live goodput accountant; a
+test capture). Emission happens only at log boundaries and around off-path
+work (eval/checkpoint/rollback), never per step — there is nothing here that
+could touch a device. A lock makes ``emit`` safe from the watchdog thread.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# The vocabulary of run events. Producers outside this package (supervisor,
+# tests) keep to this list so the offline analyzer can label everything.
+EVENT_KINDS = (
+    "run_start",      # train() entered: step=start step, total=target step
+    "run_end",        # train() exiting: exit_reason + goodput/compile summary
+    "step_window",    # a log window of pure step time: step, steps, dur_s
+    "eval",           # one evaluate() call: step, dur_s, val_loss
+    "ckpt_save",      # one checkpoint save: step, dur_s, async
+    "ckpt_restore",   # one restore (resume or rollback): step, dur_s
+    "rollback",       # anomaly rollback executed: from_step, to_step, dur_s
+    "recompile",      # post-warmup backend compile: dur_s
+    "wedge",          # watchdog fired: stalled_s
+    "preempt",        # SIGTERM stop requested: step
+    "relaunch",       # supervisor relaunched the child: attempt, backoff_s
+    "failure",        # step loop raised: step, error
+    "device_memory",  # HBM sample: per-device bytes_in_use/peak
+    "fault_injected", # drill fault fired: kind, step
+)
+
+
+def sanitize_record(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Make a record strictly-JSON serializable: non-finite floats become
+    ``null`` plus a ``<key>_nonfinite`` string ('nan' | 'inf' | '-inf').
+
+    Bare ``NaN``/``Infinity`` tokens (json.dumps' default) are invalid JSON
+    and corrupt a JSONL stream exactly when it matters most — the anomaly
+    detector logging a NaN loss. Downstream parsers get a valid line AND
+    keep the information.
+    """
+    out: Dict[str, Any] = {}
+    for key, val in record.items():
+        if isinstance(val, float) and not math.isfinite(val):
+            out[key] = None
+            out[key + "_nonfinite"] = repr(val)  # 'nan' | 'inf' | '-inf'
+        else:
+            out[key] = val
+    return out
+
+
+def json_line(record: Dict[str, Any]) -> str:
+    """One strict-JSON line (no trailing newline) for a JSONL sink."""
+    return json.dumps(sanitize_record(record), allow_nan=False)
+
+
+class EventBus:
+    """Append-only run-event stream: JSONL sink + in-process subscribers.
+
+    ``jsonl_path=""`` keeps the bus in-memory only (subscribers still fire).
+    Like MetricsLogger, the file handle reopens on demand after ``close`` so
+    the trainer can release the fd on every exit path while repeated
+    ``train()`` calls on one Trainer keep appending.
+    """
+
+    def __init__(
+        self,
+        jsonl_path: str = "",
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
+        self._path = jsonl_path
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+        self._clock = clock
+        self._wall = wall
+        self._subs: List[Callable[[Dict[str, Any]], None]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self) -> "EventBus":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close()
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        self._subs.append(fn)
+
+    def emit(self, kind: str, *, step: Optional[int] = None, **fields: Any) -> Dict[str, Any]:
+        """Record one event; returns the full stamped record.
+
+        Unknown kinds are allowed (forward compatibility for out-of-package
+        producers) — EVENT_KINDS is the documented vocabulary, not a gate.
+        """
+        with self._lock:
+            record: Dict[str, Any] = {
+                "event": kind,
+                "seq": self._seq,
+                "t_wall": self._wall(),
+                "t_mono": self._clock(),
+            }
+            self._seq += 1
+            if step is not None:
+                record["step"] = int(step)
+            record.update(fields)
+            if self._file is None and self._path:
+                self._file = open(self._path, "a")
+            if self._file is not None:
+                self._file.write(json_line(record) + "\n")
+                self._file.flush()
+        # Subscribers run outside the lock: a subscriber that emits (e.g. a
+        # telemetry sampler reacting to a window) must not deadlock.
+        for fn in self._subs:
+            fn(record)
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None

@@ -1,0 +1,103 @@
+"""Prometheus textfile exporter — no server, no client library.
+
+Writes the node-exporter "textfile collector" format: a flat file of
+``# TYPE`` headers and ``name{labels} value`` samples that node_exporter
+(or any file-scraping agent) picks up. One atomic replace per write, so a
+scraper never reads a torn file. This is the lowest-dependency way to get
+live run metrics (loss, MFU, goodput, HBM) onto a dashboard from a TPU VM:
+no port to open, no endpoint to keep alive while the host is busy driving
+the chips.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import time
+from typing import Any, Dict, Mapping, Optional
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(key: str, prefix: str) -> str:
+    name = prefix + _NAME_FIX.sub("_", key)
+    if not _NAME_OK.match(name):
+        name = "_" + name
+    return name
+
+
+def _format_value(val: float) -> str:
+    if math.isnan(val):
+        return "NaN"
+    if math.isinf(val):
+        return "+Inf" if val > 0 else "-Inf"
+    return repr(float(val))
+
+
+def _format_labels(labels: Optional[Mapping[str, Any]]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k, v in sorted(labels.items()):
+        sval = str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        parts.append(f'{_NAME_FIX.sub("_", k)}="{sval}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_lines(
+    metrics: Mapping[str, Any],
+    *,
+    prefix: str = "pllm_",
+    labels: Optional[Mapping[str, Any]] = None,
+    timestamp: Optional[float] = None,
+) -> str:
+    """Render numeric metrics as Prometheus text exposition (gauges).
+
+    Non-numeric values are skipped (the textfile format has no strings);
+    bools export as 0/1. Keys are sanitized into valid metric names.
+    """
+    label_str = _format_labels(labels)
+    ts = ""
+    if timestamp is not None:
+        ts = f" {int(timestamp * 1000)}"
+    lines = []
+    for key in sorted(metrics):
+        val = metrics[key]
+        if isinstance(val, bool):
+            val = float(val)
+        if not isinstance(val, (int, float)):
+            continue
+        name = _metric_name(key, prefix)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{label_str} {_format_value(float(val))}{ts}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_textfile(
+    path: str,
+    metrics: Mapping[str, Any],
+    *,
+    prefix: str = "pllm_",
+    labels: Optional[Mapping[str, Any]] = None,
+    stamp: bool = True,
+) -> str:
+    """Atomically write the textfile; returns the path.
+
+    ``stamp`` adds a ``<prefix>last_write_seconds`` gauge so dashboards can
+    alert on a run that stopped updating (the watchdog's out-of-band twin).
+    """
+    body = prometheus_lines(metrics, prefix=prefix, labels=labels)
+    if stamp:
+        body += prometheus_lines(
+            {"last_write_seconds": time.time()}, prefix=prefix, labels=labels
+        )
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, path)
+    return path

@@ -85,10 +85,13 @@ class FaultInjector:
     checkpoint), which is what lets a supervisor relaunch make progress.
     """
 
-    def __init__(self, spec: str, *, start_step: int = 0, logger: Any = None) -> None:
+    def __init__(
+        self, spec: str, *, start_step: int = 0, logger: Any = None, bus: Any = None
+    ) -> None:
         self.plan = parse_faults(spec)
         self.start_step = start_step
         self.logger = logger
+        self.bus = bus  # optional observability EventBus
         self._fired: set = set()
 
     def maybe_fire(self, step: int, trainer: Any) -> None:
@@ -98,6 +101,10 @@ class FaultInjector:
             self._fired.add(i)
             if self.logger is not None:
                 self.logger.log({"event": "fault_injected", "kind": kind, "step": step})
+            if self.bus is not None:
+                # Before the action: sigterm/hang never return control here.
+                # ("fault", not "kind": kind is emit's event-name parameter.)
+                self.bus.emit("fault_injected", step=step, fault=kind)
             getattr(self, f"_fire_{kind}")(trainer)
 
     # -- actions -------------------------------------------------------

@@ -25,6 +25,7 @@ training without a human in the loop:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 from pretraining_llm_tpu.config import ResilienceConfig
@@ -42,9 +43,12 @@ class RollbackManager:
       "no_checkpoint"  nothing loadable to restore; the caller must stop.
     """
 
-    def __init__(self, cfg: ResilienceConfig, logger: Any = None) -> None:
+    def __init__(self, cfg: ResilienceConfig, logger: Any = None, bus: Any = None) -> None:
         self.cfg = cfg
         self.logger = logger
+        # Optional observability EventBus: an executed rollback is a run
+        # event (its dur_s lands in the goodput "restore" bucket).
+        self.bus = bus
         self.used = 0
         self._cooldown_until = -1
         self._last_restored: Optional[int] = None
@@ -55,6 +59,7 @@ class RollbackManager:
 
     def handle(self, trainer: Any, anomaly: Anomaly) -> str:
         step = anomaly.step
+        t0 = time.perf_counter()
         if step < self._cooldown_until:
             self._log({
                 "event": "anomaly_suppressed",
@@ -127,6 +132,19 @@ class RollbackManager:
             "skipped_batches": skip,
             "budget_left": self.cfg.rollback_budget - self.used,
         })
+        if self.bus is not None:
+            # One event covers the whole recovery (restore included) — the
+            # trainer's resume path owns "ckpt_restore"; emitting both here
+            # would double-count the restore seconds in goodput.
+            self.bus.emit(
+                "rollback",
+                step=step,
+                from_step=step,
+                to_step=restored_step,
+                skipped_batches=skip,
+                anomaly=anomaly.kind,
+                dur_s=time.perf_counter() - t0,
+            )
         return "rolled_back"
 
     @property

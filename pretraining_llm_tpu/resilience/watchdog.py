@@ -42,6 +42,7 @@ class StepWatchdog:
         *,
         on_timeout: Optional[Callable[[], None]] = None,
         logger: Any = None,
+        bus: Any = None,
         exit_code: int = EXIT_WEDGED,
         exit_fn: Callable[[int], None] = os._exit,
     ) -> None:
@@ -50,6 +51,9 @@ class StepWatchdog:
         self.timeout_s = timeout_s
         self.on_timeout = on_timeout
         self.logger = logger
+        # Optional observability EventBus. The wedge event must be emitted
+        # BEFORE os._exit (which bypasses finally/atexit) or it never lands.
+        self.bus = bus
         self.exit_code = exit_code
         self._exit = exit_fn  # injectable so tests can observe instead of die
         self._last_beat: Optional[float] = None  # None = not armed yet
@@ -110,6 +114,15 @@ class StepWatchdog:
 
     def _fire(self, stalled: float) -> None:
         self._fired = True
+        if self.bus is not None:
+            try:
+                self.bus.emit(
+                    "wedge",
+                    stalled_s=round(stalled, 2),
+                    timeout_s=self.timeout_s,
+                )
+            except Exception:
+                pass
         if self.logger is not None:
             try:
                 self.logger.log({

@@ -34,6 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from pretraining_llm_tpu.observability.spans import span as _span
+
 
 def _leaf_name(path: Tuple[Any, ...]) -> str:
     parts = []
@@ -109,7 +111,10 @@ def save_checkpoint(
         os.makedirs(tmp)
     _barrier()
 
-    manifest = [_save_leaf(tmp, name, leaf) for name, leaf in _flatten_with_names(state)]
+    with _span("checkpoint/write_leaves"):
+        manifest = [
+            _save_leaf(tmp, name, leaf) for name, leaf in _flatten_with_names(state)
+        ]
     if local_extra:
         with open(os.path.join(tmp, f"local.p{jax.process_index()}.json"), "w") as f:
             json.dump(local_extra, f)
@@ -325,12 +330,13 @@ def load_checkpoint(path: str, state_template: Any) -> Tuple[Any, Dict[str, Any]
             f" (+{max(0, len(missing) - 5)} more)"
         )
     leaves = []
-    for n, (_, tmpl) in zip(names, flat_template[0]):
-        got = _load_leaf(path, entries[n])
-        want_shape = tuple(getattr(tmpl, "shape", np.shape(tmpl)))
-        if tuple(got.shape) != want_shape:
-            raise ValueError(
-                f"checkpoint leaf {n}: shape {got.shape} != expected {want_shape}"
-            )
-        leaves.append(got)
+    with _span("checkpoint/load_leaves"):
+        for n, (_, tmpl) in zip(names, flat_template[0]):
+            got = _load_leaf(path, entries[n])
+            want_shape = tuple(getattr(tmpl, "shape", np.shape(tmpl)))
+            if tuple(got.shape) != want_shape:
+                raise ValueError(
+                    f"checkpoint leaf {n}: shape {got.shape} != expected {want_shape}"
+                )
+            leaves.append(got)
     return jax.tree.unflatten(flat_template[1], leaves), meta.get("extra", {})

@@ -8,7 +8,6 @@ and MFU computed from the model's analytic FLOP count against the chip's peak.
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from typing import Any, Dict, Optional, TextIO
@@ -16,6 +15,7 @@ from typing import Any, Dict, Optional, TextIO
 import jax
 
 from pretraining_llm_tpu.config import ModelConfig
+from pretraining_llm_tpu.observability.events import json_line
 from pretraining_llm_tpu.utils.hardware import device_peak_flops
 
 
@@ -41,7 +41,11 @@ class MetricsLogger:
         if self._file is None and self._path:
             self._file = open(self._path, "a")
         if self._file is not None:
-            self._file.write(json.dumps(record) + "\n")
+            # Strict JSON: json.dumps' default emits bare NaN/Infinity
+            # tokens — invalid JSON that corrupts the JSONL exactly when
+            # the anomaly detector is logging a NaN loss. json_line maps
+            # non-finite floats to null + a "<key>_nonfinite" string.
+            self._file.write(json_line(record) + "\n")
             self._file.flush()
         parts = []
         for key, val in record.items():
@@ -95,15 +99,24 @@ class Throughput:
             self._steps = 0
             return {}
         dt = now - self._last_time
-        tok_per_sec = self._tokens / dt
-        mfu = tok_per_sec * self.flops_per_token / self.peak
-        out = {
-            "step_ms": dt / self._steps * 1e3,
-            "tokens_per_sec": tok_per_sec,
-            "tokens_per_sec_chip": tok_per_sec / self.n_chips,
-            "mfu": mfu,
-        }
+        tokens, steps = self._tokens, self._steps
         self._last_time = now
         self._tokens = 0
         self._steps = 0
-        return out
+        if dt <= 0:
+            # Coarse clocks (or two boundaries landing on the same tick)
+            # can yield dt <= 0; a rate over it is a ZeroDivisionError,
+            # not a metric. Skip this window.
+            return {}
+        tok_per_sec = tokens / dt
+        mfu = tok_per_sec * self.flops_per_token / self.peak
+        return {
+            "step_ms": dt / steps * 1e3,
+            "tokens_per_sec": tok_per_sec,
+            "tokens_per_sec_chip": tok_per_sec / self.n_chips,
+            "mfu": mfu,
+            # Raw window geometry for the observability event stream: the
+            # goodput fold needs (end step, steps, wall duration) per window.
+            "window_s": dt,
+            "window_steps": float(steps),
+        }

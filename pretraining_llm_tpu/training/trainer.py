@@ -21,6 +21,7 @@ import contextlib
 import dataclasses
 import os
 import signal
+import time
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -30,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pretraining_llm_tpu.config import Config
 from pretraining_llm_tpu.data import loader as data_loader
+from pretraining_llm_tpu.observability import ObservabilityHub
 from pretraining_llm_tpu.parallel.mesh import build_mesh
 from pretraining_llm_tpu.parallel.sharding import batch_pspec
 from pretraining_llm_tpu.training import checkpoint as ckpt
@@ -79,6 +81,9 @@ class Trainer:
         # owned logger's JSONL fd on every exit path (it reopens on demand).
         self._owns_logger = logger is None
         self.logger = logger or MetricsLogger(config.train.metrics_path)
+        # Run-wide telemetry: event bus + spans + goodput + device/compile
+        # counters. Host-side only; file sinks are config-gated and host0's.
+        self.obs = ObservabilityHub(config.obs, is_host0=jax.process_index() == 0)
         self.step_fn = ts.build_train_step(config, self.mesh)
         self.eval_loop = ts.build_eval_loop(config, self.mesh)
         self.throughput = Throughput(config.model)
@@ -159,20 +164,22 @@ class Trainer:
         # and quietly lose the whole training lineage.
         self.start_step = 0
         restored = None
+        restore_t0 = time.perf_counter()
         if resume and ckpt.latest_checkpoint(tcfg.checkpoint_dir) is not None:
             # _synced: multi-host, all processes must adopt the SAME step —
             # a host-local load failure digging deeper on one host alone
             # would deadlock the first collective.
-            restored = ckpt.restore_latest_synced(
-                tcfg.checkpoint_dir,
-                self._state_template(),
-                loader=self._checkpoint_loader,
-                on_skip=lambda path, e: self.logger.log({
-                    "event": "checkpoint_skipped",
-                    "path": path,
-                    "error": repr(e)[:200],
-                }),
-            )
+            with self.obs.spans.span("ckpt_restore"):
+                restored = ckpt.restore_latest_synced(
+                    tcfg.checkpoint_dir,
+                    self._state_template(),
+                    loader=self._checkpoint_loader,
+                    on_skip=lambda path, e: self.logger.log({
+                        "event": "checkpoint_skipped",
+                        "path": path,
+                        "error": repr(e)[:200],
+                    }),
+                )
             if restored is None:
                 raise RuntimeError(
                     f"checkpoint dir {tcfg.checkpoint_dir!r} contains step "
@@ -182,6 +189,14 @@ class Trainer:
         if restored is not None:
             state, extra, restored_step = restored
             self.start_step = self._adopt_restored(state, extra)
+            # Resume restore-time is restore-category wall-clock in the
+            # goodput budget (the replayed steps are charged separately by
+            # the step high-water mark).
+            self.obs.bus.emit(
+                "ckpt_restore",
+                step=self.start_step,
+                dur_s=time.perf_counter() - restore_t0,
+            )
             self.logger.log({
                 "event": "resumed",
                 "from": os.path.join(tcfg.checkpoint_dir, f"step-{restored_step}"),
@@ -358,7 +373,22 @@ class Trainer:
         host snapshot happens here synchronously — the saved state and
         data-RNG frontier are exactly this step's — but the file IO runs on
         a background thread and this returns None immediately. ``sync=True``
-        forces a blocking save (failure/final paths)."""
+        forces a blocking save (failure/final paths).
+
+        Every save is a span + ``ckpt_save`` event (``background=True`` when
+        only the snapshot was measured and the write continues off-thread)."""
+        t0 = time.perf_counter()
+        with self.obs.spans.span("ckpt_save"):
+            result = self._save_impl(step, sync=sync)
+        self.obs.bus.emit(
+            "ckpt_save",
+            step=step,
+            dur_s=time.perf_counter() - t0,
+            background=result is None,
+        )
+        return result
+
+    def _save_impl(self, step: int, *, sync: bool = False) -> Optional[str]:
         extra: Dict[str, Any] = {
             "step": step,
             "config": dataclasses.asdict(self.config),
@@ -441,6 +471,12 @@ class Trainer:
         collective, so only single-process runs attempt the save."""
         if jax.process_count() > 1:
             return
+        # The wedged main thread never reaches train()'s finally, so stop an
+        # in-flight profiler trace here — an open capture would otherwise be
+        # lost with the process (os._exit runs no cleanup).
+        prof = getattr(self, "_profiler", None)
+        if prof is not None:
+            prof.close()
         pending = getattr(self, "_pending_save", None)
         if pending is not None and pending.is_alive():
             pending.join(timeout=10.0)
@@ -512,6 +548,10 @@ class Trainer:
         from pretraining_llm_tpu.utils.profiling import StepProfiler
 
         profiler = StepProfiler(tcfg.profile_dir, tcfg.profile_start, tcfg.profile_steps)
+        # Exposed so the watchdog's emergency path (which os._exits past this
+        # function's finally) can stop an in-flight trace too.
+        self._profiler = profiler
+        self.obs.start_run(self.start_step, total)
 
         # --- resilience wiring (resilience/): all host-side, every piece a
         # no-op unless its config knob is set. Anomaly decisions need no
@@ -524,12 +564,13 @@ class Trainer:
             from pretraining_llm_tpu.resilience.rollback import RollbackManager
 
             detector = AnomalyDetector(rcfg)
-            rollback_mgr = RollbackManager(rcfg, logger=event_log)
+            rollback_mgr = RollbackManager(rcfg, logger=event_log, bus=self.obs.bus)
         if rcfg.faults:
             from pretraining_llm_tpu.resilience.faults import FaultInjector
 
             faults = FaultInjector(
-                rcfg.faults, start_step=self.start_step, logger=event_log
+                rcfg.faults, start_step=self.start_step, logger=event_log,
+                bus=self.obs.bus,
             )
         if rcfg.watchdog_timeout_s > 0:
             from pretraining_llm_tpu.resilience.watchdog import StepWatchdog
@@ -538,6 +579,7 @@ class Trainer:
                 rcfg.watchdog_timeout_s,
                 on_timeout=self._emergency_save,
                 logger=event_log,
+                bus=self.obs.bus,
             ).start()
 
         last: Dict[str, float] = {}
@@ -557,7 +599,10 @@ class Trainer:
                     )
                 profiler.step(step)
                 if faults is not None:
-                    faults.maybe_fire(step, self)
+                    # Injected chaos compiles its own poisoning programs (one
+                    # per param leaf); those aren't step-loop recompiles.
+                    with self.obs.suppressed_compiles():
+                        faults.maybe_fire(step, self)
                 if self._feed is not None:
                     batch = next(self._feed)
                 else:
@@ -566,6 +611,10 @@ class Trainer:
                 self.throughput.tick(tokens_per_step)
                 step += 1
                 self._completed_step = step
+                if step == self.start_step + 1:
+                    # First completed step: the initial jit is behind us, so
+                    # any later backend compile is a recompile worth an event.
+                    self.obs.mark_warm(step)
                 if watchdog is not None:
                     watchdog.heartbeat()  # first beat arms it: compile excluded
 
@@ -573,6 +622,7 @@ class Trainer:
                 if at_log and self._stop_synced():
                     preempted = True
                     self.exit_reason = "preempted"
+                    self.obs.bus.emit("preempt", step=step)
                     if is_host0:
                         self.logger.log({"event": "preempted", "step": step})
                     with _watchdog_paused(watchdog):
@@ -582,6 +632,9 @@ class Trainer:
                 if at_log:
                     last = {k: float(v) for k, v in metrics.items()}  # device sync
                     last.update(self.throughput.window())
+                    # Emit the step_window event + interval samplers; merges
+                    # the cumulative goodput fraction into the log record.
+                    last.update(self.obs.on_log_boundary(step, last, last))
                     if is_host0:
                         self.logger.log({"step": step, **last})
                     if detector is not None:
@@ -589,7 +642,11 @@ class Trainer:
                         if anomaly is not None:
                             if is_host0:
                                 self.logger.log(anomaly.as_event())
-                            with _watchdog_paused(watchdog):
+                            # The restore's device_put programs compile fresh;
+                            # suppressed_compiles keeps them out of the
+                            # recompile classification (they aren't a step-loop
+                            # shape leak).
+                            with _watchdog_paused(watchdog), self.obs.suppressed_compiles():
                                 outcome = rollback_mgr.handle(self, anomaly)
                             if outcome == "rolled_back":
                                 detector.reset()
@@ -607,7 +664,9 @@ class Trainer:
                             # "suppressed": inside the cooldown; keep going.
                 if tcfg.eval_interval > 0 and step % tcfg.eval_interval == 0:
                     with _watchdog_paused(watchdog):
-                        val_loss = self.evaluate()
+                        with self.obs.timed_event("eval", step=step) as ev:
+                            val_loss = self.evaluate()
+                            ev["val_loss"] = val_loss
                     # Standard derived views of the same number: perplexity
                     # and bits-per-token (nats -> bits) for cross-run and
                     # cross-tokenizer comparison. 700 ~ float64 exp overflow;
@@ -638,6 +697,7 @@ class Trainer:
             # (same program, same data-dependent fault); a genuinely host-local
             # fault leaves the others stuck in a collective anyway, and the
             # distributed runtime's barrier timeout is the backstop for both.
+            self.obs.bus.emit("failure", step=step, error=repr(e)[:200])
             if is_host0:
                 self.logger.log({"event": "failure", "step": step, "error": repr(e)[:200]})
             try:
@@ -701,6 +761,13 @@ class Trainer:
                 if not propagating:
                     raise
             finally:
+                # run_end must be the stream's last event; the clean paths
+                # emit it AFTER the final save below, so only a propagating
+                # exception (incl. KeyboardInterrupt/SystemExit) closes the
+                # run here — exit_reason is still "completed" then, which
+                # would mislabel the stream.
+                if propagating:
+                    self.obs.end_run("exception", step=step)
                 # Flush + release the JSONL fd on EVERY exit path (clean,
                 # preempted, rollback-budget, exception). Only a logger this
                 # Trainer created is closed — and MetricsLogger reopens on
@@ -712,6 +779,7 @@ class Trainer:
                         close()
 
         if preempted:
+            self.obs.end_run(self.exit_reason, step=step)
             return last  # already checkpointed at the stop step
         # Final save only for a genuinely completed run, labeled with the
         # step actually reached. After an anomaly break the live state is
@@ -724,4 +792,5 @@ class Trainer:
             and (tcfg.checkpoint_interval <= 0 or step % tcfg.checkpoint_interval != 0)
         ):
             self.save(step, sync=True)
+        self.obs.end_run(self.exit_reason, step=step)
         return last

@@ -53,6 +53,14 @@ class StepProfiler:
             self._active = False
 
     def close(self) -> None:
-        if self._active:
+        """Stop an in-flight capture. Idempotent and exception-safe: called
+        from every train() exit path (including the watchdog's emergency
+        path and mid-window exceptions), where a stop_trace failure must
+        not mask the original error or block the emergency save."""
+        if not self._active:
+            return
+        self._active = False
+        try:
             jax.profiler.stop_trace()
-            self._active = False
+        except Exception:
+            pass

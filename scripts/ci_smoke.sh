@@ -11,7 +11,25 @@ python -m compileall -q pretraining_llm_tpu scripts
 
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
+    tests/test_observability.py \
     "tests/test_training.py::test_checkpoint_roundtrip_and_exact_resume" \
     "tests/test_training.py::test_checkpoint_retention" \
     "tests/test_training.py::test_checkpoint_sharded_leaf_reassembly" \
     -q -p no:cacheprovider "$@"
+
+# Observability gate: a tiny synthetic run must emit parseable metrics +
+# event streams, and the offline analyzer must accept BOTH with --strict
+# (any unparseable line — e.g. a bare NaN token — fails the gate). This is
+# what keeps the JSONL schema a checked contract rather than a convention.
+OBS_TMP=$(mktemp -d)
+trap 'rm -rf "$OBS_TMP"' EXIT
+JAX_PLATFORMS=cpu python scripts/train.py --preset tiny --data synthetic \
+    --no-resume --steps 8 --obs-dir "$OBS_TMP/obs" \
+    --override train.metrics_path="$OBS_TMP/metrics.jsonl" \
+    train.checkpoint_dir="$OBS_TMP/ckpt" train.log_interval=2 \
+    train.eval_interval=4 train.eval_iters=1 train.checkpoint_interval=4 \
+    > "$OBS_TMP/train.out"
+test -s "$OBS_TMP/obs/events.jsonl"   # event stream must exist and be non-empty
+test -s "$OBS_TMP/obs/spans.trace.json"
+python scripts/obs_report.py --strict \
+    "$OBS_TMP/metrics.jsonl" "$OBS_TMP/obs/events.jsonl"

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Offline run analyzer: metrics/events JSONL in, run report out.
+
+The online half (pretraining_llm_tpu/observability/) streams events and
+metrics to JSONL during the run; this script is the post-hoc fold over those
+files — usable on a laptop against files scp'd off a pod, and run in CI over
+the smoke run so the JSONL schema stays a checked contract.
+
+    python scripts/obs_report.py run/obs/events.jsonl run/metrics.jsonl
+    python scripts/obs_report.py --json --strict ...   # CI: machine output,
+                                                       # nonzero on bad lines
+
+Pass any mix of files: records carrying ``event`` + ``t_wall`` are treated as
+run events (folded into the goodput decomposition and the event timeline);
+records carrying ``step_ms`` feed the step-time histogram. ``--strict`` makes
+unparseable lines fatal — a corrupt metrics stream (e.g. bare NaN tokens)
+must fail CI, not be skipped.
+
+Deliberately jax-free: imports only the stdlib + the observability package
+(itself stdlib-only at import), so it runs where the training stack doesn't.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
+
+# Events worth a line each in the timeline; step_window/device_memory are
+# high-rate telemetry and only counted.
+_NOTABLE = (
+    "run_start", "run_end", "eval", "ckpt_save", "ckpt_restore", "rollback",
+    "recompile", "wedge", "preempt", "relaunch", "failure", "fault_injected",
+)
+
+
+def _reject_constant(const: str) -> float:
+    raise ValueError(f"non-finite JSON constant {const!r} (invalid strict JSON)")
+
+
+def read_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Parse one JSONL file; returns (records, bad_line_count)."""
+    records: List[Dict[str, Any]] = []
+    bad = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                # parse_constant: Python's json ACCEPTS bare NaN/Infinity by
+                # default, but they are invalid JSON — exactly the corruption
+                # --strict exists to catch (a logger writing a NaN loss raw).
+                rec = json.loads(line, parse_constant=_reject_constant)
+            except ValueError:
+                bad += 1
+                print(f"{path}:{lineno}: unparseable JSON line", file=sys.stderr)
+                continue
+            if not isinstance(rec, dict):
+                bad += 1
+                print(f"{path}:{lineno}: not a JSON object", file=sys.stderr)
+                continue
+            records.append(rec)
+    return records, bad
+
+
+def split_records(
+    records: List[Dict[str, Any]],
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """(events, metrics): stamped run events vs per-step metric records."""
+    events = [r for r in records if "event" in r and "t_wall" in r]
+    metrics = [r for r in records if "step_ms" in r]
+    return events, metrics
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def step_time_stats(metrics: List[Dict[str, Any]], bins: int = 10) -> Dict[str, Any]:
+    vals = sorted(
+        float(r["step_ms"]) for r in metrics
+        if isinstance(r.get("step_ms"), (int, float))
+    )
+    if not vals:
+        return {"count": 0}
+    lo, hi = vals[0], vals[-1]
+    width = (hi - lo) / bins if hi > lo else 1.0
+    counts = [0] * bins
+    for v in vals:
+        counts[min(bins - 1, int((v - lo) / width))] += 1
+    return {
+        "count": len(vals),
+        "mean_ms": sum(vals) / len(vals),
+        "p50_ms": _percentile(vals, 0.50),
+        "p90_ms": _percentile(vals, 0.90),
+        "max_ms": hi,
+        "histogram": [
+            {"lo_ms": lo + i * width, "hi_ms": lo + (i + 1) * width, "count": c}
+            for i, c in enumerate(counts)
+        ],
+    }
+
+
+def timeline(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Chronological notable events, timestamped relative to the first."""
+    stamped = sorted(events, key=lambda e: e["t_wall"])
+    if not stamped:
+        return []
+    t0 = stamped[0]["t_wall"]
+    out = []
+    for e in stamped:
+        if e["event"] not in _NOTABLE:
+            continue
+        entry = {"t_rel_s": round(e["t_wall"] - t0, 3), "event": e["event"]}
+        for key in (
+            "step", "dur_s", "to_step", "why", "rc", "exit_reason",
+            "anomaly", "fault",
+        ):
+            if key in e:
+                entry[key] = e[key]
+        out.append(entry)
+    return out
+
+
+def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
+    events, metrics = split_records(records)
+    counts: Dict[str, int] = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    report: Dict[str, Any] = {
+        "n_records": len(records),
+        "n_events": len(events),
+        "n_metric_records": len(metrics),
+        "event_counts": dict(sorted(counts.items())),
+        "step_time": step_time_stats(metrics, bins),
+        "timeline": timeline(events),
+    }
+    if events:
+        report["goodput"] = GoodputAccountant.fold(events)
+    return report
+
+
+def print_report(report: Dict[str, Any]) -> None:
+    good = report.get("goodput")
+    if good:
+        total = good["total_s"]
+        print("== goodput ==")
+        print(f"total wall-clock  {total:.3f}s over {good['runs']} run(s)")
+        print(f"goodput           {good['goodput']:.3f}")
+        for cat in CATEGORIES:
+            sec = good["categories"][cat]
+            pct = 100.0 * sec / total if total > 0 else 0.0
+            bar = "#" * int(round(pct / 2))
+            print(f"  {cat:<11} {sec:9.3f}s {pct:5.1f}% {bar}")
+        print(f"rollbacks={good['rollbacks']} recompiles={good['recompiles']} "
+              f"max_step={good['max_step']} exit={good['exit_reason']}")
+    st = report["step_time"]
+    print("== step time ==")
+    if st["count"] == 0:
+        print("no step_ms records")
+    else:
+        print(f"windows={st['count']} mean={st['mean_ms']:.2f}ms "
+              f"p50={st['p50_ms']:.2f}ms p90={st['p90_ms']:.2f}ms "
+              f"max={st['max_ms']:.2f}ms")
+        peak = max(b["count"] for b in st["histogram"]) or 1
+        for b in st["histogram"]:
+            bar = "#" * int(round(30 * b["count"] / peak))
+            print(f"  [{b['lo_ms']:9.2f}, {b['hi_ms']:9.2f}) {b['count']:5d} {bar}")
+    print("== events ==")
+    if not report["event_counts"]:
+        print("no events")
+    for kind, n in report["event_counts"].items():
+        print(f"  {kind:<15} {n}")
+    if report["timeline"]:
+        print("== timeline ==")
+        for entry in report["timeline"]:
+            extra = " ".join(
+                f"{k}={v}" for k, v in entry.items()
+                if k not in ("t_rel_s", "event")
+            )
+            print(f"  +{entry['t_rel_s']:9.3f}s {entry['event']:<13} {extra}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument("paths", nargs="+", help="metrics/events JSONL files")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="exit nonzero if any line fails to parse (CI schema gate)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--bins", type=int, default=10, help="step-time histogram bins")
+    args = parser.parse_args()
+
+    records: List[Dict[str, Any]] = []
+    bad = 0
+    for path in args.paths:
+        recs, nbad = read_jsonl(path)
+        records.extend(recs)
+        bad += nbad
+    report = build_report(records, args.bins)
+    report["bad_lines"] = bad
+    if args.json:
+        print(json.dumps(report, indent=2, allow_nan=False))
+    else:
+        print_report(report)
+        if bad:
+            print(f"!! {bad} unparseable line(s)", file=sys.stderr)
+    if args.strict and bad:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

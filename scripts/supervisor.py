@@ -65,6 +65,37 @@ def _log(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
+class _EventWriter:
+    """Append supervisor events to the same JSONL stream the trainer's
+    EventBus writes (--events pointed at the trainer's obs events file), in
+    the same record shape (event/seq/t_wall/t_mono), so obs_report.py folds
+    relaunches into one run-wide timeline. Duplicated rather than imported:
+    the supervisor must stay pure-stdlib (importable when JAX is wedged).
+    Every write is best-effort — a full disk must not kill the relauncher."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._seq = 0
+
+    def emit(self, kind: str, **fields) -> None:
+        if not self.path:
+            return
+        self._seq += 1
+        record = {
+            "event": kind,
+            "seq": self._seq,
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+            "supervisor": True,
+            **fields,
+        }
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record, allow_nan=False) + "\n")
+        except (OSError, ValueError):
+            pass
+
+
 def parse_args(argv):
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
@@ -86,6 +117,11 @@ def parse_args(argv):
         help="backoff ceiling in seconds",
     )
     parser.add_argument(
+        "--events", default="", metavar="PATH",
+        help="append relaunch/exit events (trainer EventBus JSONL schema) "
+        "here; point it at the run's obs events file for one merged timeline",
+    )
+    parser.add_argument(
         "--healthy-secs", type=float, default=300.0,
         help="a child surviving this long before a CRASH resets the failure "
         "count (wedges never reset it: their lifetime includes the whole "
@@ -105,6 +141,7 @@ def supervise(args, cmd) -> int:
     failures = 0
     preemptions = 0
     launches = 0
+    events = _EventWriter(getattr(args, "events", ""))
     # SIGTERM handling: a TERM delivered to the supervisor ALONE (not the
     # whole process group) must not kill it outright — that would orphan
     # the training child and lose the EXIT_PREEMPTED relaunch contract.
@@ -159,13 +196,16 @@ def supervise(args, cmd) -> int:
                 return 0
             if rc == EXIT_ANOMALY:
                 _log({"event": "fatal", "why": "anomaly budget exhausted; needs a human"})
+                events.emit("failure", rc=rc, why="anomaly_budget")
                 return rc
             if rc == EXIT_PREEMPTED:
                 preemptions += 1
                 if preemptions > args.max_preemptions:
                     _log({"event": "fatal", "why": "preemption budget exhausted"})
+                    events.emit("failure", rc=rc, why="preemption_budget")
                     return rc
                 _log({"event": "relaunch", "why": "preempted", "backoff_s": 0})
+                events.emit("relaunch", rc=rc, why="preempted", attempt=launches)
                 continue
 
             # Wedge or crash: exponential backoff, bounded budget. The
@@ -180,10 +220,14 @@ def supervise(args, cmd) -> int:
             failures += 1
             if failures > args.max_restarts:
                 _log({"event": "fatal", "why": "restart budget exhausted", "failures": failures - 1})
+                events.emit("failure", rc=rc, why="restart_budget")
                 return rc
             backoff = min(args.backoff_base * 2 ** (failures - 1), args.backoff_max)
             why = "wedged" if rc == EXIT_WEDGED else f"crash rc={rc}"
             _log({"event": "relaunch", "why": why, "failures": failures, "backoff_s": backoff})
+            events.emit(
+                "relaunch", rc=rc, why=why, attempt=launches, backoff_s=backoff
+            )
             time.sleep(backoff)
     finally:
         if prev_term is not None:

@@ -60,6 +60,13 @@ def main() -> None:
         "--data", default="files", choices=["files", "synthetic"],
         help="'synthetic' trains on a generated Markov stream (no files needed)",
     )
+    parser.add_argument(
+        "--obs-dir", default="", metavar="DIR",
+        help="enable run-wide telemetry under DIR: events.jsonl (EventBus), "
+        "spans.trace.json (Perfetto), metrics.prom (Prometheus textfile); "
+        "analyze offline with scripts/obs_report.py. Explicit obs.* "
+        "overrides win over the derived paths",
+    )
     parser.add_argument("--no-resume", action="store_true", help="ignore existing checkpoints")
     parser.add_argument("--steps", type=int, default=None, help="override total steps")
     parser.add_argument(
@@ -69,7 +76,16 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    config = get_preset(args.preset).with_overrides(parse_overrides(args.override))
+    overrides = parse_overrides(args.override)
+    if args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
+        for key, fname in (
+            ("obs.events_path", "events.jsonl"),
+            ("obs.spans_path", "spans.trace.json"),
+            ("obs.prometheus_path", "metrics.prom"),
+        ):
+            overrides.setdefault(key, os.path.join(args.obs_dir, fname))
+    config = get_preset(args.preset).with_overrides(overrides)
     if jax.process_index() == 0:
         print(f"preset={config.name} devices={jax.device_count()} "
               f"params={config.model.num_params()/1e6:.1f}M")
