@@ -726,6 +726,37 @@ class ObservabilityConfig:
             )
 
 
+@dataclass(frozen=True)
+class ServingConfig:
+    """Decode-serving scheduler knobs (generation/serving.py).
+
+    These gate host-side scheduling only — they never change emitted tokens
+    (the greedy output contract in ServingEngine.run holds at every depth).
+    """
+
+    # In-flight decode-window queue depth for the pipelined scheduler:
+    # how many dispatched-but-unreaped windows the engine keeps queued
+    # before it blocks on the oldest. 1 reproduces the classic
+    # double-buffered scheduler (reap window k-1 right after dispatching
+    # window k); 2 lets the host reap/consume/admit a full window behind
+    # the device, hiding the host work of one boundary entirely.
+    pipeline_depth: int = 2
+    # Cross-window admission batching: defer waiting prefills until at
+    # least this many could be admitted in one batched prefill (0 or 1 =
+    # admit eagerly every boundary). Deferral only happens while the
+    # device still has active rows — an idle engine always admits
+    # whatever fits, so batching can never deadlock the queue.
+    admit_batch: int = 0
+
+    def __post_init__(self) -> None:
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.admit_batch < 0:
+            raise ValueError(f"admit_batch must be >= 0, got {self.admit_batch}")
+
+
 # ---------------------------------------------------------------------------
 # Top-level
 # ---------------------------------------------------------------------------
@@ -739,6 +770,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     name: str = "custom"
 
     # NOTE: pipeline stage assignment (P('pipe', ...) on the stacked layer
@@ -760,7 +792,7 @@ class Config:
         for key, value in overrides.items():
             if "." in key:
                 section, fname = key.split(".", 1)
-                if section not in ("model", "mesh", "data", "train", "resilience", "obs"):
+                if section not in ("model", "mesh", "data", "train", "resilience", "obs", "serving"):
                     raise KeyError(f"unknown config section {section!r} in override {key!r}")
                 sections.setdefault(section, {})[fname] = value
             else:
@@ -794,6 +826,8 @@ class Config:
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             # Absent in checkpoints written before the observability subsystem.
             obs=ObservabilityConfig(**raw.get("obs", {})),
+            # Absent in checkpoints written before the serving scheduler knobs.
+            serving=ServingConfig(**raw.get("serving", {})),
             name=raw.get("name", "custom"),
         )
 

@@ -100,6 +100,20 @@ class BlockAllocator:
         self._live.update(ids)
         return ids
 
+    def alloc_upto(self, n: int) -> List[int]:
+        """Up to ``n`` block ids — possibly fewer, possibly empty. The
+        opportunistic multi-window page-horizon path: the pipelined serving
+        scheduler pre-grows rows toward ``window * pipeline_depth`` write
+        slots from the free list only, so a page flush never has to land
+        between an already-dispatched window and its reap. Grants beyond a
+        row's true need are speculative; callers roll them back with
+        ``free()`` (release, preemption, or the reclaim pass)."""
+        if n < 0:
+            raise ValueError(f"alloc_upto({n})")
+        ids = [self._free.pop() for _ in range(min(n, len(self._free)))]
+        self._live.update(ids)
+        return ids
+
     def free(self, ids: Sequence[int]) -> None:
         for i in ids:
             if i not in self._live:

@@ -22,11 +22,24 @@ scratch block (block 0) with their outputs ignored — a masked no-op is
 cheaper than a recompile, and XLA sees a static (max_batch,) program
 forever. The reference has no serving stack (batch-1 fixed-count
 generate, /root/reference/src/models/transformer.py:96-114).
+
+Deep pipelining: the run() scheduler keeps a depth-``pipeline_depth``
+queue of dispatched-but-unreaped decode windows. Window k+1's input
+tokens chain from window k's last column ON DEVICE, host ``seq_lens``
+advance speculatively at dispatch, and the host reap/consume/admission
+work for windows k-1, k-2, ... overlaps the device's execution of
+window k. Speculation is reconciled by FLUSHING the queue (a synchronous
+drain back to committed host state) whenever a decision needs exact
+state — preemption and page reclaim — and replaying from there; events
+the lag contract already absorbs (a row finishing early, a
+sampling-dependent admission landing mid-queue) need no flush because
+surplus tokens are discarded at reap by the snapshot identity check.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 import warnings
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
@@ -36,8 +49,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from pretraining_llm_tpu.config import ModelConfig
-from pretraining_llm_tpu.generation import paged
+from pretraining_llm_tpu.generation import paged, speculative
 from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability import spans as _spans
 
 
 @dataclasses.dataclass
@@ -65,6 +79,23 @@ class _Request:
         the value scheduling math (max_new countdown, page horizons) must
         use so deferred resolution never changes allocation decisions."""
         return len(self.generated) + (1 if self.pending_first is not None else 0)
+
+
+@dataclasses.dataclass
+class _Window:
+    """One dispatched-but-unreaped unit of device work in the in-flight
+    queue. ``snapshot`` pins the (row, request) pairs the window was
+    dispatched against: at reap, rows whose identity changed since (the
+    request finished in an earlier reap, possibly re-admitted) are surplus
+    by the lag contract and their tokens are discarded."""
+
+    kind: str                       # "decode" | "spec"
+    snapshot: List[tuple]           # [(row, _Request)] at dispatch time
+    n: int                          # decode: window length; spec: k+1 bound
+    toks: Any = None                # decode: (B, n) device tokens
+    emit: Any = None                # spec: (B, k+1) device emissions
+    n_emit: Any = None              # spec: (B,) device per-row emit counts
+    seq_dev: Any = None             # spec: (B,) device frontier at dispatch
 
 
 class ServingEngine:
@@ -98,6 +129,8 @@ class ServingEngine:
         stop_token: Optional[int] = None,
         seed: int = 0,
         steps_per_sched: int = 1,
+        pipeline_depth: int = 2,
+        admit_batch: int = 0,
         mesh: Any = None,
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
@@ -170,6 +203,22 @@ class ServingEngine:
         # on the tunneled backend. Rows finishing mid-window overrun into
         # their own pages (surplus discarded host-side).
         self.steps_per_sched = max(1, int(steps_per_sched))
+        # Deep pipelining: how many dispatched-but-unreaped windows the
+        # run() scheduler keeps queued before blocking on the oldest.
+        # 1 = the classic double-buffered scheduler; 2 (default) hides a
+        # full window of host reap/consume/admission work behind the
+        # device. Purely host scheduling: greedy outputs are identical at
+        # every depth (see run()).
+        if pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        self.pipeline_depth = int(pipeline_depth)
+        # Cross-window admission batching: defer waiting prefills until at
+        # least this many could be admitted in ONE batched prefill (0/1 =
+        # admit eagerly). Deferral only happens while rows are running —
+        # an idle engine admits whatever fits, so no deadlock.
+        if admit_batch < 0:
+            raise ValueError(f"admit_batch must be >= 0, got {admit_batch}")
+        self.admit_batch = int(admit_batch)
 
         # Sharded serving: params arrive pre-sharded
         # (generate.shard_params_for_inference); the KV pools shard their
@@ -231,12 +280,20 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
-        # Pipelined scheduling state: the in-flight window (tokens still
-        # on device) and admission token merges queued for the next
-        # dispatch — see _run_pipelined.
-        self._inflight: Optional[tuple] = None
+        # Pipelined scheduling state: the queue of in-flight windows
+        # (tokens still on device, oldest first) and admission token
+        # merges queued for the next dispatch — see _run_pipelined.
+        self._inflight: deque = deque()
         self._pending_admit_merges: List[tuple] = []
-        self.stats = {"steps": 0, "tokens": 0, "preemptions": 0, "admissions": 0}
+        self.stats = {
+            "steps": 0, "tokens": 0, "preemptions": 0, "admissions": 0,
+            # Pipelined-scheduler telemetry: windows dispatched/reaped and
+            # the host seconds spent blocked on a window's readback — the
+            # quantity deep pipelining exists to shrink (host_blocked_s /
+            # windows_reaped is the per-window counter bench.py reports).
+            "windows": 0, "windows_reaped": 0, "host_blocked_s": 0.0,
+            "flushes": 0,
+        }
 
     # -- public API --------------------------------------------------------
 
@@ -381,61 +438,90 @@ class ServingEngine:
     def run(self, *, pipeline: bool = True) -> Dict[int, List[int]]:
         """Drive the engine until every submitted request has finished.
 
-        ``pipeline=True`` (default) runs the double-buffered scheduler:
-        window k+1 is DISPATCHED before window k's results are read back,
-        so the host's reap/admit work and the readback round trip overlap
-        the device's execution instead of idling it — the device only
-        drains when admission needs pool space held by unreaped rows.
-        The price is one window of lag on finish detection (a finished
-        row decodes one surplus window before its slot frees; surplus
-        tokens were already discarded by design). Greedy outputs are
-        IDENTICAL to pipeline=False; with temperature > 0 the sampling
-        key stream differs (window keys split in dispatch order).
+        ``pipeline=True`` (default) runs the deep-pipelined scheduler: a
+        queue of up to ``pipeline_depth`` dispatched-but-unreaped windows.
+        Window k+1's inputs chain from window k's last tokens ON DEVICE,
+        so the host's reap/consume/admission work for older windows and
+        their readback round trips overlap the device's execution instead
+        of idling it — the device only drains when a decision needs exact
+        host state (preemption, page reclaim), which flushes the queue
+        and replays from committed state. The price is up to
+        ``pipeline_depth`` windows of lag on finish detection (a finished
+        row decodes surplus windows before its slot frees; surplus tokens
+        are discarded at reap). Greedy outputs are IDENTICAL to
+        pipeline=False at EVERY depth — per-row greedy decoding depends
+        only on the row's own history, never on scheduling; with
+        temperature > 0 the sampling key stream differs (window keys
+        split in dispatch order, and deeper queues dispatch more surplus
+        windows).
 
-        Speculative serving (spec_k > 0) always runs the synchronous
-        loop: each round's page horizon depends on the previous round's
-        data-dependent acceptance, so windows cannot be dispatched ahead
-        of their reap. (Spec already amortizes dispatch ~(k+1)x per
-        accepted run — the lever pipelining provides for plain decode.)
+        Speculative serving (spec_k > 0) joins the same in-flight queue:
+        round k+1 chains its seed tokens AND its frontier from round k's
+        device-resident result (speculative.spec_next_inputs), so the
+        data-dependent acceptance no longer forces a per-round host sync;
+        the page horizon is pre-ensured for the worst-case (k+1) advance
+        of every queued round. Committed host ``seq_lens`` advance at
+        reap by the round's actual emit count.
         """
-        if not pipeline or self.spec_k:
+        if not pipeline:
             while self.has_work():
                 self.step()
             return self.finished
         return self._run_pipelined()
 
     def _run_pipelined(self) -> Dict[int, List[int]]:
-        assert self._inflight is None, "re-entrant run()"
-        while self.has_work() or self._inflight is not None:
+        assert not self._inflight, "re-entrant run()"
+        depth = self.pipeline_depth
+        while self.has_work() or self._inflight:
             self._admit(defer=True)
-            n = self._window_len()
             if self.n_active:
-                # ONE window length for both the page horizon and the
-                # dispatch: ensure_write_pages may flush/preempt (which
-                # only shrinks the remaining budget), and a dispatch
-                # longer than the ensured horizon would scratch-redirect
-                # live writes — computing n once makes that impossible
-                # by construction, not by a cross-call invariant.
-                self._ensure_write_pages(horizon=n)
-            prev = self._inflight
-            if self.n_active:
-                self._inflight = self._dispatch_window(n)
-            else:
-                self._inflight = None
-            if prev is not None:
-                # Blocks until window k-1 is done — while window k (just
-                # dispatched) executes behind it on the device stream.
-                self._reap_window(prev)
+                if self.spec_k:
+                    # Worst case every queued round and the new one
+                    # advance the device frontier by k+1 past the
+                    # committed seq_lens — pre-ensure the whole horizon
+                    # so no flush can land between dispatch and reap.
+                    k = self.spec_k
+                    self._ensure_write_pages(
+                        horizon=(k + 1) * (len(self._inflight) + 1)
+                    )
+                    if self.n_active:
+                        self._dispatch_spec_round()
+                else:
+                    n = self._window_len()
+                    # ONE window length for both the page horizon and the
+                    # dispatch: ensure_write_pages may flush/preempt
+                    # (which only shrinks the remaining budget), and a
+                    # dispatch longer than the ensured horizon would
+                    # scratch-redirect live writes — computing n once
+                    # makes that impossible by construction. ``prealloc``
+                    # opportunistically extends rows toward the full
+                    # in-flight horizon (n * depth slots) from the free
+                    # list, so later dispatches rarely need new pages at
+                    # all — a page flush between an already-dispatched
+                    # window and its reap becomes the exception.
+                    self._ensure_write_pages(
+                        horizon=n, prealloc=n * (depth - 1)
+                    )
+                    if self.n_active:
+                        self._dispatch_window(n)
+            # Reap the oldest window once the queue exceeds its depth —
+            # by then it has had `depth` windows of device time to finish,
+            # so the readback rarely blocks — and drain outright when
+            # nothing is running (end of stream, or everyone preempted).
+            while (len(self._inflight) > depth
+                   or (self._inflight and not self.n_active)):
+                self._reap_window(self._inflight.popleft())
         return self.finished
 
-    def _dispatch_window(self, n: int) -> tuple:
+    def _dispatch_window(self, n: int) -> None:
         """Enqueue one ``steps_per_sched``-step decode window WITHOUT
-        waiting for the previous one: input tokens come from the previous
-        window's last column (still on device) merged with admission
-        first-tokens (also on device); seq_lens advance host-side by the
-        window length (every active row writes exactly that many slots,
-        finished-or-not — surplus is discarded at reap). ``n`` is the
-        window length the caller already ensured pages for."""
+        waiting for the queued ones: input tokens come from the youngest
+        in-flight window's last column (still on device) merged with
+        admission first-tokens (also on device); seq_lens advance
+        host-side by the window length (every active row writes exactly
+        that many slots, finished-or-not — surplus is discarded at reap).
+        ``n`` is the window length the caller already ensured pages
+        for."""
         capacity = self.max_blocks * self.block_size
         # Clamp: a finished-but-unreaped row may have written up to its
         # full allocation; feeding seq == capacity would trip the bounds
@@ -447,46 +533,140 @@ class ServingEngine:
         paged.check_paged_bounds(
             self.tables[active], seq_dispatch[active], self.block_size
         )
-        if self._inflight is not None:
-            base = self._inflight[0][:, -1]  # (B,) device, no sync
-        else:
-            base = jnp.asarray(self.tokens)
-        for toks_dev, idxs, rows in self._pending_admit_merges:
-            base = base.at[jnp.asarray(rows, jnp.int32)].set(
-                toks_dev[jnp.asarray(idxs, jnp.int32)]
+        with _spans.span("serving.dispatch_window", steps=n):
+            if self._inflight:
+                base = self._inflight[-1].toks[:, -1]  # (B,) device, no sync
+            else:
+                base = jnp.asarray(self.tokens)
+            base = self._merge_admitted(base)
+            self._key, sub = jax.random.split(self._key)
+            toks, self.pools = paged.paged_decode_steps(
+                self.params, self.pools, base, jnp.asarray(self.tables),
+                jnp.asarray(seq_dispatch), sub, cfg=self.cfg, n_steps=n,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
             )
-        self._pending_admit_merges = []
-        self._key, sub = jax.random.split(self._key)
-        toks, self.pools = paged.paged_decode_steps(
-            self.params, self.pools, base, jnp.asarray(self.tables),
-            jnp.asarray(seq_dispatch), sub, cfg=self.cfg, n_steps=n,
-            temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
-        )
         self.stats["steps"] += n
+        self.stats["windows"] += 1
         snapshot = [(i, self.rows[i]) for i in active]
         for i in active:
             self.seq_lens[i] = min(int(self.seq_lens[i]) + n, capacity)
-        return (toks, snapshot, n)
+        self._inflight.append(
+            _Window(kind="decode", snapshot=snapshot, n=n, toks=toks)
+        )
 
-    def _reap_window(self, inflight: tuple) -> None:
+    def _dispatch_spec_round(self) -> None:
+        """Enqueue one speculative round against the device-resident
+        frontier: seed tokens and seq_lens chain from the youngest queued
+        round via spec_next_inputs (no host sync); rows admitted since
+        the last dispatch are spliced in from committed host state. With
+        an empty queue (start, or right after a reconciliation flush)
+        both come from committed host state — the replay path."""
+        k = self.spec_k
+        capacity = self.max_blocks * self.block_size
+        seq_committed = np.minimum(self.seq_lens, capacity - 1)
+        active = [i for i, r in enumerate(self.rows) if r is not None]
+        # The bounds invariant is checked on COMMITTED state (a lower
+        # bound on the device frontier); in-flight advances stay inside
+        # the pre-ensured horizon by construction.
+        paged.check_paged_bounds(
+            self.tables[active], seq_committed[active], self.block_size
+        )
+        with _spans.span("serving.dispatch_window", steps=k + 1):
+            if self._inflight:
+                prev = self._inflight[-1]
+                base, seq_dev = speculative.spec_next_inputs(
+                    prev.emit, prev.n_emit, prev.seq_dev
+                )
+            else:
+                base = jnp.asarray(self.tokens)
+                seq_dev = jnp.asarray(self.seq_lens)
+            base, seq_dev = self._merge_admitted(base, seq_dev)
+            self._key, sub = jax.random.split(self._key)
+            emit, n_emit, self.pools, self.d_pools = paged.paged_spec_round(
+                self.params, self.pools, self.d_pools, self.draft_params,
+                base, jnp.asarray(self.tables), seq_dev, sub,
+                cfg_t=self.cfg, cfg_d=self.draft_cfg, k=k,
+                temperature=self.temperature, mesh=self.mesh,
+            )
+        self.stats["steps"] += 1
+        self.stats["windows"] += 1
+        self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) + 1
+        snapshot = [(i, self.rows[i]) for i in active]
+        self._inflight.append(
+            _Window(kind="spec", snapshot=snapshot, n=k + 1,
+                    emit=emit, n_emit=n_emit, seq_dev=seq_dev)
+        )
+
+    def _merge_admitted(self, base, seq_dev=None):
+        """Splice rows admitted since the last dispatch into the chained
+        device inputs: their prefill-sampled first token, and (spec mode)
+        their committed frontier — a released row's stale chain values
+        are otherwise garbage by design (zero tables scratch its writes),
+        but a RE-ADMITTED row must restart from committed host state."""
+        for toks_dev, idxs, rows in self._pending_admit_merges:
+            r = jnp.asarray(rows, jnp.int32)
+            base = base.at[r].set(toks_dev[jnp.asarray(idxs, jnp.int32)])
+            if seq_dev is not None:
+                seq_dev = seq_dev.at[r].set(
+                    jnp.asarray(self.seq_lens[np.asarray(rows)], jnp.int32)
+                )
+        self._pending_admit_merges = []
+        return base if seq_dev is None else (base, seq_dev)
+
+    def _reap_window(self, w: _Window) -> None:
         """Materialize a window's tokens and do the lagged bookkeeping:
         resolve deferred first tokens, extend outputs, finish rows that
         hit stop/max_new (their surplus in-window tokens are discarded,
-        exactly as in the synchronous path)."""
-        toks_dev, snapshot, n = inflight
-        window = np.asarray(toks_dev)  # (B, n) — THE sync point
-        for row, req in snapshot:
-            if req.row != row or self.rows[row] is not req:
-                # The row finished in an earlier reap and may have been
-                # re-admitted since; this window's tokens for it are
-                # surplus garbage by the lag contract. (Preemption can't
-                # land here: it flushes the inflight window first.)
-                continue
-            self._resolve_first(req)
-            if req.row is None:  # first token alone finished it
-                continue
-            self._consume_tokens(req, row, window[row], advance_seq=False)
+        exactly as in the synchronous path). The readback wait is the
+        host-blocked time deep pipelining exists to hide — measured per
+        window into stats and the span's trace args."""
+        with _spans.span("serving.reap_window",
+                         window=self.stats["windows_reaped"]) as meta:
+            t0 = time.perf_counter()
+            with _spans.span("serving.host_blocked"):
+                if w.kind == "spec":
+                    emit = np.asarray(w.emit)      # (B, k+1) — THE sync point
+                    n_emit = np.asarray(w.n_emit)  # (B,)
+                else:
+                    window = np.asarray(w.toks)    # (B, n) — THE sync point
+            blocked = time.perf_counter() - t0
+            meta["host_blocked_s"] = round(blocked, 6)
+            self.stats["host_blocked_s"] += blocked
+            self.stats["windows_reaped"] += 1
+            capacity = self.max_blocks * self.block_size
+            for row, req in w.snapshot:
+                if req.row != row or self.rows[row] is not req:
+                    # The row finished in an earlier reap and may have
+                    # been re-admitted since; this window's tokens for it
+                    # are surplus garbage by the lag contract. (Preemption
+                    # can't land here: it flushes the queue first.)
+                    continue
+                self._resolve_first(req)
+                if req.row is None:  # first token alone finished it
+                    continue
+                if w.kind == "spec":
+                    # Commit the round's data-dependent advance. Proposal/
+                    # acceptance telemetry counts here (not at dispatch)
+                    # so surplus rounds for finished rows skew neither
+                    # side of the hit rate.
+                    ne = int(n_emit[row])
+                    self.seq_lens[row] = min(
+                        int(self.seq_lens[row]) + ne, capacity
+                    )
+                    self.stats["spec_proposed"] = (
+                        self.stats.get("spec_proposed", 0) + self.spec_k
+                    )
+                    self.stats["spec_accepted"] = (
+                        self.stats.get("spec_accepted", 0) + ne - 1
+                    )
+                    self._consume_tokens(
+                        req, row, emit[row, :ne], advance_seq=False
+                    )
+                else:
+                    self._consume_tokens(
+                        req, row, window[row], advance_seq=False
+                    )
 
     def _consume_tokens(self, req: _Request, row: int, toks,
                         advance_seq: bool) -> None:
@@ -508,11 +688,16 @@ class ServingEngine:
                 break  # surplus tokens for this row are discarded
 
     def _flush_inflight(self) -> None:
-        """Synchronously drain the in-flight window (pipelined mode) so
-        host state is exact — required before preemption decisions."""
-        if self._inflight is not None:
-            prev, self._inflight = self._inflight, None
-            self._reap_window(prev)
+        """Reconciliation: synchronously drain EVERY in-flight window,
+        oldest first, so host state is exact/committed — required before
+        preemption decisions and speculative-page reclaim. The caller
+        then replays from committed state (the next dispatch finds an
+        empty queue and restarts the device chain from host tokens/
+        seq_lens)."""
+        if self._inflight:
+            self.stats["flushes"] += 1
+        while self._inflight:
+            self._reap_window(self._inflight.popleft())
 
     def _resolve_first(self, req: _Request) -> None:
         """Materialize a deferred admission token (device is done with it
@@ -530,6 +715,25 @@ class ServingEngine:
 
     # -- scheduling internals ---------------------------------------------
 
+    def _admission_capacity(self) -> int:
+        """How many queue heads could be admitted RIGHT NOW under the
+        free-row + watermark rules, without committing anything — the
+        ``admit_batch`` gate's lookahead."""
+        free_rows = sum(r is None for r in self.rows)
+        avail = self.alloc.available
+        active = self.n_active
+        count = 0
+        for req in self.waiting:
+            if count >= free_rows:
+                break
+            need = paged.required_blocks(len(req.prompt) + 1, self.block_size)
+            if avail - need < active:
+                break
+            avail -= need
+            active += 1
+            count += 1
+        return count
+
     def _admit(self, defer: bool = False) -> None:
         """FCFS admission: every queue head that fits claims a free row,
         then ALL claimed prompts prefill in ONE device program (batched
@@ -541,7 +745,25 @@ class ServingEngine:
         tokens on device: bookkeeping that needs their VALUES (stop
         tokens, output lists) lags until the window they join is reaped,
         while scheduling math uses ``n_generated`` which already counts
-        them."""
+        them.
+
+        Cross-window admission batching (``admit_batch`` > 1, pipelined
+        only): while the device has work, waiting prefills accumulate
+        until one batched admission can take ``admit_batch`` of them —
+        turning per-boundary dribble admissions (one prefill program
+        each) into one larger prefill at the boundary where rows/pages
+        free up. Greedy outputs are unaffected: a request's tokens depend
+        only on its own prompt, never on when it was admitted."""
+        if defer and self.admit_batch > 1 and self.waiting and self.n_active:
+            goal = min(self.admit_batch, len(self.waiting), self.max_batch)
+            if self._admission_capacity() < goal:
+                self.stats["admit_deferrals"] = (
+                    self.stats.get("admit_deferrals", 0) + 1
+                )
+                return
+            self.stats["admit_batches"] = (
+                self.stats.get("admit_batches", 0) + 1
+            )
         admits: List[_Request] = []
         while self.waiting:
             free_rows = [i for i, r in enumerate(self.rows) if r is None]
@@ -612,21 +834,32 @@ class ServingEngine:
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
 
-    def _ensure_write_pages(self, horizon: int = 1) -> None:
+    def _ensure_write_pages(self, horizon: int = 1, prealloc: int = 0) -> None:
         """Every active row's next ``horizon`` write slots must have
         allocated pages (writes landing in a surviving row's unallocated
         page would silently fall through to the scratch block and LOSE
-        that token's K/V); when the pool is dry, preempt youngest-first
-        (recompute-on-resume) so the oldest admitted requests always make
-        progress. Slots a row cannot reach before finishing (remaining <
-        horizon) or that exceed table capacity don't need pages — those
-        surplus writes are scratch-redirected and discarded by design."""
+        that token's K/V); when the pool is dry, drain the in-flight
+        queue, then roll back other rows' speculative page grants, and
+        only then preempt youngest-first (recompute-on-resume) so the
+        oldest admitted requests always make progress. Slots a row cannot
+        reach before finishing (remaining < horizon) or that exceed table
+        capacity don't need pages — those surplus writes are
+        scratch-redirected and discarded by design.
+
+        ``prealloc`` extends the target a further N slots
+        OPPORTUNISTICALLY: extra pages come from the free list only
+        (never a flush, never a preemption) and keep one headroom block
+        per active row so admission's watermark is untouched. The
+        pipelined scheduler uses it to cover the full in-flight horizon
+        (window * depth), making a mid-queue page flush the exception;
+        over-grants are speculative and rolled back at release,
+        preemption, or by _reclaim_spec_pages under pressure."""
         capacity = self.max_blocks * self.block_size
         for row in range(self.max_batch):
             req = self.rows[row]
             if req is None:
                 continue
-            # n_generated may lag the device by one in-flight window
+            # n_generated may lag the device by the in-flight queue
             # (pipelined mode): remaining is then an OVERestimate, so the
             # horizon only ever covers extra slots — writes stay inside
             # allocated (or scratch-redirected) pages either way.
@@ -642,14 +875,16 @@ class ServingEngine:
                     req.blocks.extend(got)
                     self.tables[row, len(req.blocks) - 1] = got[0]
                     continue
-                if self._inflight is not None:
-                    # Pool dry with a window in flight: drain it first —
-                    # its finished rows may free blocks, and preemption
+                if self._inflight:
+                    # Pool dry with windows in flight: drain them first —
+                    # their finished rows may free blocks, and preemption
                     # bookkeeping (prompt+generated) must be exact.
                     self._flush_inflight()
                     if self.rows[row] is not req:
                         break  # this row finished in the flush
                     continue  # retry allocation against the fresh state
+                if self._reclaim_spec_pages(horizon):
+                    continue  # speculative grants rolled back; retry
                 victim = max(
                     (r for r in self.rows if r is not None),
                     key=lambda r: r.admit_order,
@@ -657,6 +892,70 @@ class ServingEngine:
                 self._preempt(victim)
                 if victim is req or self.rows[row] is not req:
                     break  # this row is gone; nothing more to grow
+        if prealloc > 0:
+            self._prealloc_write_pages(horizon + prealloc)
+
+    def _prealloc_write_pages(self, horizon: int) -> None:
+        """Best-effort page growth toward ``horizon`` write slots per live
+        row — free-list only, stopping at one headroom block per active
+        row (the same constant admission's watermark protects)."""
+        capacity = self.max_blocks * self.block_size
+        for row in range(self.max_batch):
+            req = self.rows[row]
+            if req is None:
+                continue
+            remaining = req.max_new - req.n_generated
+            last_write = min(
+                int(self.seq_lens[row]) + min(horizon, remaining) - 1,
+                capacity - 1,
+            )
+            need_pages = last_write // self.block_size + 1
+            want = need_pages - len(req.blocks)
+            spare = self.alloc.available - self.n_active
+            if want <= 0 or spare <= 0:
+                continue
+            got = self.alloc.alloc_upto(min(want, spare))
+            for b in got:
+                req.blocks.append(b)
+                self.tables[row, len(req.blocks) - 1] = b
+            if got:
+                self.stats["page_preallocs"] = (
+                    self.stats.get("page_preallocs", 0) + len(got)
+                )
+            if len(got) < want:
+                return  # pool has no spare pages this boundary
+
+    def _reclaim_spec_pages(self, horizon: int) -> int:
+        """Roll back speculative page grants: free every live row's
+        blocks beyond its committed ``horizon`` coverage. Only legal with
+        an empty in-flight queue (callers flush first) — then no write
+        can target the reclaimed pages, and all live K/V sits below the
+        committed frontier, which the kept coverage strictly contains.
+        Returns the number of blocks returned to the pool."""
+        assert not self._inflight, "reclaim needs committed state"
+        capacity = self.max_blocks * self.block_size
+        freed = 0
+        for row in range(self.max_batch):
+            req = self.rows[row]
+            if req is None:
+                continue
+            remaining = req.max_new - req.n_generated
+            last_write = min(
+                int(self.seq_lens[row]) + min(horizon, remaining) - 1,
+                capacity - 1,
+            )
+            need_pages = last_write // self.block_size + 1
+            if len(req.blocks) > need_pages:
+                surplus = req.blocks[need_pages:]
+                del req.blocks[need_pages:]
+                self.tables[row, need_pages:] = 0
+                self.alloc.free(surplus)
+                freed += len(surplus)
+        if freed:
+            self.stats["page_reclaims"] = (
+                self.stats.get("page_reclaims", 0) + freed
+            )
+        return freed
 
     def _preempt(self, req: _Request) -> None:
         """Evict a running request: free its memory, requeue it at the

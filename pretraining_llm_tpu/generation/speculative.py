@@ -191,6 +191,25 @@ def _spec_jit(params_t, params_d, prompt, key, *, cfg_t, cfg_d, total,
     return out[:max_new_tokens], stats
 
 
+@jax.jit
+def spec_next_inputs(
+    emit: jax.Array,      # (B, k+1) int32 round emissions
+    n_emit: jax.Array,    # (B,) int32 tokens emitted per row (>= 1)
+    seq_lens: jax.Array,  # (B,) int32 frontier the round was dispatched at
+) -> Tuple[jax.Array, jax.Array]:
+    """Next round's (seed token, frontier) chained on-device from a
+    ``paged_spec_round`` result, without a host sync. The last emitted
+    token of row b is ``emit[b, n_emit[b]-1]`` — by construction the
+    round's ``final`` token, i.e. exactly the token the synchronous
+    scheduler would feed back after consuming the round on the host. This
+    is what lets speculative rounds join the serving engine's in-flight
+    window queue: the device chains round k+1 off round k while the host
+    is still reaping round k-1."""
+    b = emit.shape[0]
+    nxt = emit[jnp.arange(b), jnp.maximum(n_emit, 1) - 1]
+    return nxt, seq_lens + n_emit
+
+
 def generate_speculative(
     params_target: Any,
     cfg_target: ModelConfig,

@@ -30,8 +30,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 class SpanRecorder:
     def __init__(self, max_events: int = 200_000) -> None:
         self.max_events = max_events
-        # (name, t_start perf_counter s, dur s, thread id, depth)
-        self._events: List[Tuple[str, float, float, int, int]] = []
+        # (name, t_start perf_counter s, dur s, thread id, depth, meta)
+        self._events: List[Tuple[str, float, float, int, int, Dict[str, Any]]] = []
         self._dropped = 0
         self._lock = threading.Lock()
         self._local = threading.local()
@@ -41,19 +41,25 @@ class SpanRecorder:
         self._perf0 = time.perf_counter()
 
     @contextlib.contextmanager
-    def span(self, name: str, **meta: Any) -> Iterator[None]:
+    def span(self, name: str, **meta: Any) -> Iterator[Dict[str, Any]]:
+        """Record a span; ``meta`` (plus anything the body adds to the
+        yielded dict) lands in the Chrome trace event's ``args``, so
+        per-span counters — e.g. the serving scheduler's host-blocked
+        seconds per decode window — are inspectable in Perfetto. Values
+        must be JSON-serializable."""
         depth = getattr(self._local, "depth", 0)
         self._local.depth = depth + 1
         t0 = time.perf_counter()
+        out: Dict[str, Any] = dict(meta)
         try:
-            yield
+            yield out
         finally:
             dur = time.perf_counter() - t0
             self._local.depth = depth
             with self._lock:
                 if len(self._events) < self.max_events:
                     self._events.append(
-                        (name, t0, dur, threading.get_ident(), depth)
+                        (name, t0, dur, threading.get_ident(), depth, out)
                     )
                 else:
                     self._dropped += 1
@@ -61,14 +67,17 @@ class SpanRecorder:
     # -- aggregate views ----------------------------------------------
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        """Per-name count + total seconds (host accounting, log-friendly)."""
+        """Per-name count + total/max seconds (host accounting). ``max_s``
+        singles out the straggler occurrence — for the serving reap span
+        that is the window where the host actually blocked on the device."""
         with self._lock:
             events = list(self._events)
         out: Dict[str, Dict[str, float]] = {}
-        for name, _t0, dur, _tid, _depth in events:
-            agg = out.setdefault(name, {"count": 0, "total_s": 0.0})
+        for name, _t0, dur, _tid, _depth, _meta in events:
+            agg = out.setdefault(name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
             agg["count"] += 1
             agg["total_s"] += dur
+            agg["max_s"] = max(agg["max_s"], dur)
         return out
 
     @property
@@ -81,7 +90,7 @@ class SpanRecorder:
             events = list(self._events)
         pid = os.getpid()
         trace = []
-        for name, t0, dur, tid, depth in events:
+        for name, t0, dur, tid, depth, meta in events:
             trace.append({
                 "name": name,
                 "ph": "X",
@@ -89,7 +98,7 @@ class SpanRecorder:
                 "dur": dur * 1e6,
                 "pid": pid,
                 "tid": tid,
-                "args": {"depth": depth},
+                "args": {"depth": depth, **meta},
             })
         return {
             "traceEvents": trace,

@@ -33,3 +33,32 @@ test -s "$OBS_TMP/obs/events.jsonl"   # event stream must exist and be non-empty
 test -s "$OBS_TMP/obs/spans.trace.json"
 python scripts/obs_report.py --strict \
     "$OBS_TMP/metrics.jsonl" "$OBS_TMP/obs/events.jsonl"
+
+# Serving decode gate: 8 requests through the deep-pipelined scheduler
+# (depth 2) on a tiny random-init model must finish, emit a token count,
+# and report the host-blocked window telemetry — the end-to-end proof
+# that dispatch/reap/admission survive outside the pytest fixtures.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax, dataclasses
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+eng = ServingEngine(params, cfg, max_batch=4, n_blocks=32, block_size=8,
+                    temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                    admit_batch=2)
+rng = np.random.default_rng(0)
+rids = [eng.submit(rng.integers(0, cfg.vocab_size, size=5 + i).tolist(), 8)
+        for i in range(8)]
+out = eng.run(pipeline=True)
+assert set(out) == set(rids), (sorted(out), rids)
+assert all(len(out[r]) == 8 for r in rids), {r: len(out[r]) for r in rids}
+st = eng.stats
+assert st["windows_reaped"] == st["windows"] > 0, st
+assert st["host_blocked_s"] >= 0.0, st
+print(f"serving smoke ok: {st['tokens']} tokens, {st['windows']} windows, "
+      f"host_blocked_s={st['host_blocked_s']:.4f}")
+EOF

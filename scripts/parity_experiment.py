@@ -150,16 +150,51 @@ def run_jax(args, model_cfg, train_path, val_path, init_npz):
     # framework math, not matmul rounding mode.
     jax.config.update("jax_default_matmul_precision", "highest")
     state = ts.init_train_state(cfg, jax.random.key(0))
-    # Persist the exact initial weights for the torch twin.
-    flat = jax.tree_util.tree_flatten_with_path(state["params"])[0]
-    np.savez(
-        init_npz,
-        __model_kw__=np.frombuffer(json.dumps(MODEL_KW, sort_keys=True).encode(), np.uint8),
-        **{
-            "__".join(str(getattr(e, "key", e)) for e in path): np.asarray(leaf, np.float32)
-            for path, leaf in flat
-        },
-    )
+    if os.path.exists(init_npz):
+        # The committed init.npz is an ARTIFACT: results.json pins its sha
+        # (init_sha), so a rerun must LOAD it — not regenerate and overwrite
+        # it, which silently rebased the recorded identity every time the
+        # experiment ran (and made the banked curves unreproducible when the
+        # init routine drifted). Delete the file to start a fresh experiment.
+        raw = dict(np.load(init_npz))
+        saved_kw = (
+            json.loads(bytes(raw.pop("__model_kw__")).decode())
+            if "__model_kw__" in raw else None
+        )
+        if saved_kw != json.loads(json.dumps(MODEL_KW, sort_keys=True)):
+            raise ValueError(
+                f"{init_npz} was written for a different MODEL_KW — delete "
+                "it to regenerate (the recorded curves will no longer be "
+                "comparable)."
+            )
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state["params"])
+        leaves = []
+        for path, leaf in flat:
+            key = "__".join(str(getattr(e, "key", e)) for e in path)
+            if key not in raw:
+                raise ValueError(
+                    f"{init_npz} is missing param {key!r} — delete it to "
+                    "regenerate."
+                )
+            if raw[key].shape != leaf.shape:
+                raise ValueError(
+                    f"{init_npz} param {key!r} has shape {raw[key].shape}, "
+                    f"model wants {leaf.shape} — delete it to regenerate."
+                )
+            leaves.append(jnp.asarray(raw[key], leaf.dtype))
+        state["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
+        print(f"[jax] loaded shared init from {init_npz}", flush=True)
+    else:
+        # First run: persist the exact initial weights for the torch twin.
+        flat = jax.tree_util.tree_flatten_with_path(state["params"])[0]
+        np.savez(
+            init_npz,
+            __model_kw__=np.frombuffer(json.dumps(MODEL_KW, sort_keys=True).encode(), np.uint8),
+            **{
+                "__".join(str(getattr(e, "key", e)) for e in path): np.asarray(leaf, np.float32)
+                for path, leaf in flat
+            },
+        )
     step = ts.build_train_step(cfg, mesh=None)
     it = loader.get_batch_iterator(
         train_path, BATCH, model_cfg.context_length, seed=DATA_SEED
@@ -385,8 +420,9 @@ def main():
     def _corpus_sha() -> str:
         # The data streams the delta depends on: the train stream and the
         # val set eval_loss is measured on. The shared initial weights are
-        # a SEPARATE identity (init_sha): the jax side rewrites init.npz,
-        # so folding it in here would make the value depend on run order.
+        # a SEPARATE identity (init_sha): the jax side writes init.npz on
+        # a first run (and loads it thereafter), so folding it in here
+        # would make the value depend on run order.
         h = hashlib.sha256(open(train_bin, "rb").read())
         h.update(open(val_bin, "rb").read())
         return h.hexdigest()
@@ -439,10 +475,11 @@ def main():
     if args.only in ("", "jax"):
         new_jax = run_jax(args, model_cfg, train_bin, val_bin, init_npz)
         new_jax["corpus_sha"] = corpus_sha
-        # Post-run: the jax side (re)writes init.npz — stamp what this run
-        # actually produced, and refuse if it no longer matches what the
-        # recorded torch twin trained from (a jax-version drift would
-        # otherwise silently compare curves across different inits).
+        # Post-run: the jax side LOADED an existing init.npz (or wrote it
+        # on a first run) — stamp the file this run actually trained from,
+        # and refuse if it doesn't match what the recorded torch twin
+        # trained from (belt-and-braces: a hand-deleted/regenerated file
+        # would otherwise silently compare curves across different inits).
         new_jax["init_sha"] = _file_sha(init_npz)
         rec_torch = results.get("torch")
         if (
@@ -452,11 +489,12 @@ def main():
             and rec_torch["init_sha"] != new_jax["init_sha"]
         ):
             print(json.dumps({
-                "error": f"init drift: this jax run regenerated init.npz "
-                         f"with sha {new_jax['init_sha'][:16]} but the "
-                         f"recorded torch twin trained from "
+                "error": f"init drift: this jax run trained from init.npz "
+                         f"sha {new_jax['init_sha'][:16]} but the recorded "
+                         f"torch twin trained from "
                          f"{rec_torch['init_sha'][:16]} — the curves are "
-                         "not comparable; retrain BOTH sides",
+                         "not comparable; restore the committed "
+                         "data/parity/init.npz or retrain BOTH sides",
             }))
             return 2
         # A rerun on a DIFFERENT backend must not destroy the banked
@@ -479,8 +517,9 @@ def main():
     if args.only in ("", "torch"):
         results["torch"] = run_torch(args, model_cfg, train_bin, val_bin, init_npz)
         results["torch"]["corpus_sha"] = corpus_sha
-        # Post-run: in a full run, run_jax just rewrote init.npz and torch
-        # trained from those bytes — stamp the file torch actually read.
+        # Post-run: in a full run, run_jax loaded (or first-run wrote)
+        # init.npz and torch trained from those bytes — stamp the file
+        # torch actually read.
         results["torch"]["init_sha"] = _file_sha(init_npz)
     with open(results_path, "w") as fh:
         json.dump(results, fh, indent=2)

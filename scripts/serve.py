@@ -61,7 +61,17 @@ def main() -> None:
     parser.add_argument("--spec_k", type=int, default=4,
                         help="draft proposals per speculative round")
     parser.add_argument("--no-pipeline", action="store_true",
-                        help="disable the double-buffered scheduler")
+                        help="disable the pipelined scheduler (fully "
+                        "synchronous dispatch/reap baseline)")
+    parser.add_argument("--pipeline_depth", type=int, default=0,
+                        help="in-flight decode-window queue depth (0 = "
+                        "config/engine default; 1 = classic double "
+                        "buffering). Host scheduling only — greedy "
+                        "outputs are identical at every depth")
+    parser.add_argument("--admit_batch", type=int, default=0,
+                        help="accumulate waiting prefills until this many "
+                        "can be admitted in ONE batched admission (0/1 = "
+                        "admit eagerly at every window boundary)")
     parser.add_argument("--tokenizer", default=None,
                         help="override the checkpoint's tokenizer name")
     parser.add_argument("--output", default="",
@@ -97,7 +107,10 @@ def main() -> None:
         block_size=args.block_size, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
         stop_token=args.stop_token, seed=args.seed,
-        steps_per_sched=args.steps_per_sched, **spec,
+        steps_per_sched=args.steps_per_sched,
+        pipeline_depth=args.pipeline_depth or cfg.serving.pipeline_depth,
+        admit_batch=args.admit_batch or cfg.serving.admit_batch,
+        **spec,
     )
     rids = {}
     rejected = []

@@ -80,6 +80,28 @@ def test_json_roundtrip():
     assert restored == cfg
 
 
+def test_serving_config_wiring():
+    from pretraining_llm_tpu.config import ServingConfig
+
+    cfg = get_preset("tiny").with_overrides(
+        {"serving.pipeline_depth": 3, "serving.admit_batch": 4}
+    )
+    assert cfg.serving.pipeline_depth == 3
+    assert cfg.serving.admit_batch == 4
+    assert Config.from_json(cfg.to_json()).serving == cfg.serving
+    # Pre-serving checkpoints (no "serving" section) load with defaults.
+    import json as _json
+
+    raw = _json.loads(get_preset("tiny").to_json())
+    raw.pop("serving")
+    legacy = Config.from_json(_json.dumps(raw))
+    assert legacy.serving == ServingConfig()
+    with pytest.raises(ValueError):
+        ServingConfig(pipeline_depth=0)
+    with pytest.raises(ValueError):
+        ServingConfig(admit_batch=-1)
+
+
 # Perf-preset intent table. Round 4 found the 350M preset silently running
 # NAIVE attention for every pre-2026-08-01 measurement (only gpt2-124m set
 # attention_impl="flash") — caught by a human reading a profile. This table
