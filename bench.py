@@ -64,12 +64,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
         "--mode", default="train", choices=["train", "decode", "trainer",
-                                            "serving"],
+                                            "serving", "serving-slo"],
         help="train: tokens/sec + MFU of the train step (the driver metric); "
         "decode: KV-cached generation tokens/sec; trainer: the FULL Trainer "
         "loop incl. the input pipeline (measures host-sampling overlap — "
         "compare --prefetch 0 vs 2); serving: continuous-batching paged "
-        "engine throughput (mixed-length requests through a fixed row set)",
+        "engine throughput (mixed-length requests through a fixed row set); "
+        "serving-slo: ONLINE latency under Poisson load through the "
+        "frontend EngineLoop — p50/p99 TTFT and goodput-under-SLO, not "
+        "offline throughput",
     )
     parser.add_argument(
         "--steps-per-sched", type=int, default=0,
@@ -191,6 +194,23 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--spec-k", type=int, default=4,
         help="serving mode: draft proposals per speculative round",
+    )
+    parser.add_argument(
+        "--rate-rps", type=float, default=4.0,
+        help="serving-slo mode: open-loop Poisson arrival rate",
+    )
+    parser.add_argument(
+        "--slo-ttft-s", type=float, default=1.0,
+        help="serving-slo mode: TTFT bound a request must meet to count "
+        "toward goodput (0 = no TTFT bound)",
+    )
+    parser.add_argument(
+        "--slo-e2e-s", type=float, default=10.0,
+        help="serving-slo mode: end-to-end bound for goodput (0 = none)",
+    )
+    parser.add_argument(
+        "--n-requests", type=int, default=0,
+        help="serving-slo mode: workload size (0 = 3x max_batch)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -475,6 +495,106 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
     return rec
 
 
+def run_serving_slo_bench(args: argparse.Namespace) -> dict:
+    """Online serving latency under load: seeded Poisson arrivals through
+    the frontend EngineLoop (the same continuous loop the HTTP gateway
+    drives), reporting p50/p99 TTFT, TPOT and e2e plus goodput-under-SLO —
+    completed requests that met the SLO bounds, per second. --mode serving
+    measures what the engine sustains offline; this measures what a CLIENT
+    experiences while requests arrive mid-decode."""
+    import jax
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.frontend.admission import AdmissionController
+    from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+    from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_engine_loop
+    from pretraining_llm_tpu.generation.generate import decode_bench_workload
+    from pretraining_llm_tpu.generation.serving import ServingEngine
+
+    noop = {
+        "--attention": args.attention, "--remat": args.remat, "--ce": args.ce,
+        "--optimizer": args.optimizer, "--unroll": args.unroll,
+        "--block-q": args.block_q, "--block-kv": args.block_kv,
+        "--ragged": args.ragged, "--decode-unroll": args.decode_unroll,
+        "--context": args.context, "--grad-dtype": args.grad_dtype,
+        "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
+    }
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(f"{', '.join(bad)} have no effect on the serving-slo path")
+
+    cfg = get_preset(args.preset).model
+    if args.kv_dtype:
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+    if args.paged_attn:
+        cfg = dataclasses.replace(cfg, paged_attention_impl=args.paged_attn)
+    if args.cache_layout:
+        cfg = dataclasses.replace(cfg, decode_cache_layout=args.cache_layout)
+    max_batch = args.batch or 8
+    if args.quick:
+        max_batch = min(max_batch, 4)
+    cfg, params, canon_prompt, new_tokens = decode_bench_workload(
+        cfg, max_batch, quick=args.quick
+    )
+    prompt_len = int(canon_prompt.shape[1])
+    block_size = min(64, cfg.context_length)
+    n_requests = args.n_requests or 3 * max_batch
+    pages_per_req = -(-(prompt_len + new_tokens) // block_size)
+    n_blocks = max_batch * pages_per_req + max_batch + 1
+
+    sps = args.steps_per_sched or 8
+    depth = args.pipeline_depth or 2
+
+    eng = ServingEngine(
+        params, cfg, max_batch=max_batch, n_blocks=n_blocks,
+        block_size=block_size, temperature=0.0,
+        steps_per_sched=sps, pipeline_depth=depth,
+        admit_batch=args.admit_batch,
+    )
+    spec = LoadSpec(
+        n_requests=n_requests, mode="open", rate_rps=args.rate_rps,
+        vocab_size=cfg.vocab_size,
+        prompt_len_min=max(1, prompt_len // 4), prompt_len_max=prompt_len,
+        max_new_min=new_tokens, max_new_max=new_tokens,
+        slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s, seed=0,
+    )
+    admission = AdmissionController(max_queue_depth=4 * max_batch)
+    loop = EngineLoop(eng, admission=admission)
+    with loop:
+        # Warm the compiled programs (prefill buckets + the window program)
+        # outside the measured window, like the other modes' warmup pass.
+        warm = loop.submit([1] * prompt_len, new_tokens)
+        warm.result()
+        report = run_engine_loop(loop, spec)
+    s = report.summary()
+    return {
+        "metric": f"serving_slo_goodput_{args.preset}",
+        "value": round(s["goodput_rps"], 3),
+        "unit": "slo_ok_requests_per_sec",
+        "vs_baseline": None,  # the reference has no serving stack
+        "slo_attainment": round(s["slo_attainment"], 4),
+        "counts": s["counts"],
+        "n_requests": n_requests,
+        "rate_rps": args.rate_rps,
+        "slo_ttft_s": args.slo_ttft_s,
+        "slo_e2e_s": args.slo_e2e_s,
+        "ttft_p50_s": round(s["ttft"]["p50"], 4),
+        "ttft_p99_s": round(s["ttft"]["p99"], 4),
+        "tpot_p50_s": round(s["tpot"]["p50"], 5),
+        "e2e_p50_s": round(s["e2e"]["p50"], 4),
+        "e2e_p99_s": round(s["e2e"]["p99"], 4),
+        "throughput_tok_s": round(s["throughput_tok_s"], 1),
+        "max_batch": max_batch,
+        "new_tokens_per_request": new_tokens,
+        "steps_per_sched": sps,
+        "pipeline_depth": depth,
+        "block_size": block_size,
+        "n_blocks": n_blocks,
+        "wall_s": round(report.wall_s, 2),
+        "device": jax.devices()[0].device_kind,
+    }
+
+
 def run_trainer_bench(args: argparse.Namespace) -> dict:
     """Tokens/sec of the FULL Trainer loop (synthetic data): step dispatch +
     host sampling + H2D, i.e. what the train CLI actually sustains. The
@@ -590,6 +710,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         return run_trainer_bench(args)
     if args.mode == "serving":
         return run_serving_bench(args)
+    if args.mode == "serving-slo":
+        return run_serving_slo_bench(args)
 
     # Decode-only knobs are REJECTED on the train path (mirror of the
     # decode-mode noop guard): a silently-ignored flag would emit a record
@@ -784,6 +906,9 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         if args.cache_layout != "stacked":  # effective default: unstacked
             metric += "_unstacked"
         unit = "generated_tokens_per_sec"
+    elif args.mode == "serving-slo":
+        metric = f"serving_slo_goodput_{args.preset}"
+        unit = "slo_ok_requests_per_sec"
     else:
         metric, unit = f"mfu_{args.preset}_train", "fraction_of_peak_bf16"
         if args.context:
@@ -794,7 +919,7 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         "unit": unit,
         # Same null contract as the success path: decode/serving have no
         # reference baseline, so their failure records carry null too.
-        "vs_baseline": None if args.mode in ("decode", "serving") else 0.0,
+        "vs_baseline": None if args.mode in ("decode", "serving", "serving-slo") else 0.0,
         "error": msg[:800],
         "attempts": attempts,
     }
@@ -958,6 +1083,13 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--paged-attn", args.paged_attn]
     if args.spec_draft:
         cmd += ["--spec-draft", args.spec_draft, "--spec-k", str(args.spec_k)]
+    if args.mode == "serving-slo":
+        cmd += [
+            "--rate-rps", str(args.rate_rps),
+            "--slo-ttft-s", str(args.slo_ttft_s),
+            "--slo-e2e-s", str(args.slo_e2e_s),
+            "--n-requests", str(args.n_requests),
+        ]
     if args.cache_layout:
         cmd += ["--cache-layout", args.cache_layout]
     if args.context:

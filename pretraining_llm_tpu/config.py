@@ -757,6 +757,61 @@ class ServingConfig:
             raise ValueError(f"admit_batch must be >= 0, got {self.admit_batch}")
 
 
+@dataclass(frozen=True)
+class FrontendConfig:
+    """Online serving gateway knobs (frontend/).
+
+    All host-side: none of these change emitted tokens. They bound what the
+    HTTP frontend ADMITS, not how the engine schedules what was admitted.
+    """
+
+    # Gateway bind address. Port 0 binds an ephemeral port (tests read it
+    # back from ServingGateway.port).
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # Backpressure: max requests admitted and not yet terminal; excess gets
+    # HTTP 429 + Retry-After instead of an unbounded queue wait.
+    max_queue_depth: int = 64
+    # Outstanding-token budget (sum of prompt + max_new over live
+    # requests); 0 = unlimited. A depth bound alone cannot tell ten tiny
+    # requests from one huge one.
+    max_outstanding_tokens: int = 0
+    # Retry-After hint (seconds) attached to 429 responses.
+    retry_after_s: float = 1.0
+    # Reject requests whose optimistic service estimate (decode-only TPOT
+    # EWMA) already exceeds their deadline, instead of admitting them to
+    # miss it (HTTP 504 at submit time).
+    shed_infeasible: bool = True
+    # Default per-request deadline applied when the client sends none;
+    # 0 = no default deadline.
+    default_deadline_s: float = 0.0
+    # How long the idle engine-loop thread sleeps between inbox polls.
+    idle_wait_s: float = 0.005
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.port <= 65535:
+            raise ValueError(f"port must be in [0, 65535], got {self.port}")
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}"
+            )
+        if self.max_outstanding_tokens < 0:
+            raise ValueError(
+                f"max_outstanding_tokens must be >= 0, got "
+                f"{self.max_outstanding_tokens}"
+            )
+        if self.retry_after_s <= 0:
+            raise ValueError(
+                f"retry_after_s must be > 0, got {self.retry_after_s}"
+            )
+        if self.default_deadline_s < 0:
+            raise ValueError(
+                f"default_deadline_s must be >= 0, got {self.default_deadline_s}"
+            )
+        if self.idle_wait_s <= 0:
+            raise ValueError(f"idle_wait_s must be > 0, got {self.idle_wait_s}")
+
+
 # ---------------------------------------------------------------------------
 # Top-level
 # ---------------------------------------------------------------------------
@@ -771,6 +826,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    frontend: FrontendConfig = field(default_factory=FrontendConfig)
     name: str = "custom"
 
     # NOTE: pipeline stage assignment (P('pipe', ...) on the stacked layer
@@ -792,7 +848,7 @@ class Config:
         for key, value in overrides.items():
             if "." in key:
                 section, fname = key.split(".", 1)
-                if section not in ("model", "mesh", "data", "train", "resilience", "obs", "serving"):
+                if section not in ("model", "mesh", "data", "train", "resilience", "obs", "serving", "frontend"):
                     raise KeyError(f"unknown config section {section!r} in override {key!r}")
                 sections.setdefault(section, {})[fname] = value
             else:
@@ -828,6 +884,8 @@ class Config:
             obs=ObservabilityConfig(**raw.get("obs", {})),
             # Absent in checkpoints written before the serving scheduler knobs.
             serving=ServingConfig(**raw.get("serving", {})),
+            # Absent in checkpoints written before the serving gateway.
+            frontend=FrontendConfig(**raw.get("frontend", {})),
             name=raw.get("name", "custom"),
         )
 

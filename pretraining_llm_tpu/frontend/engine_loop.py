@@ -1,0 +1,339 @@
+"""Long-lived engine thread: the online request lifecycle over ServingEngine.
+
+``ServingEngine.run()`` is offline — every request is submitted up front
+and the call drains to completion. This loop makes the engine ONLINE:
+
+  - one dedicated thread owns the engine (and therefore all device
+    dispatch; JAX state never crosses threads) and repeatedly calls
+    ``pipeline_tick()``, the single deep-pipelined scheduler turn;
+  - gateway threads ``submit()`` into a thread-safe inbox; the loop
+    drains it BETWEEN scheduler turns, so requests arriving mid-decode
+    join the engine's waiting queue and are admitted at the next window
+    boundary without disturbing in-flight windows;
+  - committed tokens stream to per-request queues via the engine's
+    ``on_token`` hook (commit time = reap time under deep pipelining, so
+    a streamed token is never retracted);
+  - cancellation and per-request deadlines are applied between turns:
+    the engine's ``cancel()`` flushes the in-flight window queue before
+    releasing the victim's row and pool blocks (see ServingEngine.cancel
+    for why the flush must come first), so surviving requests' outputs
+    are bit-identical to a run that never saw the victim.
+
+Terminal statuses mirror the HTTP story: ``done`` (200), ``cancelled``
+(499 client closed), ``expired`` (504 deadline), ``error`` (500).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from pretraining_llm_tpu.frontend.admission import AdmissionController, Ticket
+
+TERMINAL_STATUSES = ("done", "cancelled", "expired", "error")
+
+
+@dataclasses.dataclass
+class FrontendRequest:
+    """One in-flight request as the frontend sees it. ``out_q`` carries
+    ``("token", int)`` items followed by exactly one
+    ``("end", status, info)`` tuple; ``tokens``/``status``/``info`` are
+    the loop thread's authoritative copies, safe to read after the end
+    event has been consumed."""
+
+    prompt: List[int]
+    max_new: int
+    deadline: Optional[float]  # monotonic deadline, None = none
+    submitted_s: float
+    ticket: Optional[Ticket] = None
+    out_q: "queue.Queue[Tuple]" = dataclasses.field(default_factory=queue.Queue)
+    rid: Optional[int] = None
+    status: str = "queued"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    cancel_requested: bool = False
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[Tuple]:
+        """Yield stream events until (and including) the terminal
+        ``("end", status, info)``. ``timeout`` bounds the wait for EACH
+        event; expiry raises ``TimeoutError``."""
+        while True:
+            try:
+                ev = self.out_q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no stream event within {timeout}s (status={self.status})"
+                )
+            yield ev
+            if ev[0] == "end":
+                return
+
+    def result(
+        self, timeout: Optional[float] = None
+    ) -> Tuple[str, List[int], Dict[str, Any]]:
+        """Drain the stream; returns (status, tokens, info)."""
+        for _ in self.events(timeout=timeout):
+            pass
+        return self.status, self.tokens, self.info
+
+
+class EngineLoop:
+    """Owns a ServingEngine on a dedicated thread; see module docstring.
+
+    ``bus`` (optional, observability.events.EventBus) receives per-request
+    lifecycle events: req_submit, req_done, req_cancelled, req_expired —
+    each terminal event carries queue_wait_s/ttft_s/e2e_s and the token
+    count, so the event stream is the serving audit log.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        admission: Optional[AdmissionController] = None,
+        bus: Any = None,
+        idle_wait_s: float = 0.005,
+        clock: Any = time.monotonic,
+    ) -> None:
+        self.engine = engine
+        self.admission = admission
+        self.bus = bus
+        self.idle_wait_s = float(idle_wait_s)
+        # Deadlines compare against this clock; injectable so tests can
+        # expire a request mid-flight deterministically.
+        self._clock = clock
+        engine.on_token = self._on_token
+        engine.on_finish = self._on_finish
+        self._inbox: "queue.Queue[FrontendRequest]" = queue.Queue()
+        self._by_rid: Dict[int, FrontendRequest] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # counters only
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
+            "errors": 0, "tokens_streamed": 0,
+        }
+
+    # -- public API (any thread) -------------------------------------------
+
+    def start(self) -> "EngineLoop":
+        assert self._thread is None, "start() called twice"
+        self._thread = threading.Thread(
+            target=self._run, name="engine-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop the loop thread. Outstanding requests get an ``error``
+        terminal event ("shutdown") — a serving process going down does
+        not pretend in-flight work completed."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self) -> "EngineLoop":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> FrontendRequest:
+        """Validate, pass admission, enqueue. Raises ``ValueError`` on a
+        malformed request (gateway: 400), ``RejectedBusy`` (429) or
+        ``RejectedInfeasible`` (504) from the admission controller.
+        Returns immediately with the request handle; tokens stream on its
+        ``out_q``."""
+        if self._stop.is_set() or self._thread is None:
+            raise RuntimeError("EngineLoop is not running")
+        # validate_request reads only construction-time constants — safe
+        # from gateway threads while the loop thread drives the engine.
+        max_new = self.engine.validate_request(prompt, max_new_tokens)
+        ticket = None
+        if self.admission is not None:
+            ticket = self.admission.try_admit(
+                len(prompt), max_new, deadline_s=deadline_s
+            )
+        now = self._clock()
+        req = FrontendRequest(
+            prompt=[int(t) for t in prompt],
+            max_new=max_new,
+            deadline=(now + deadline_s) if deadline_s is not None else None,
+            submitted_s=now,
+            ticket=ticket,
+        )
+        with self._lock:
+            self.counters["submitted"] += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "req_submit", n_prompt=len(req.prompt), max_new=max_new,
+                deadline_s=deadline_s,
+            )
+        self._inbox.put(req)
+        self._wake.set()
+        return req
+
+    def cancel(self, req: FrontendRequest) -> None:
+        """Request cancellation (client disconnect / explicit abort). The
+        loop applies it between scheduler turns; tokens already committed
+        stay delivered, then the handle gets a ``cancelled`` terminal."""
+        req.cancel_requested = True
+        self._wake.set()
+
+    def metrics(self) -> Dict[str, float]:
+        """Counter snapshot for /metrics: loop counters + live gauges +
+        the engine's numeric stats (prefixed ``engine_``) + admission."""
+        with self._lock:
+            out: Dict[str, float] = dict(self.counters)
+        out["active_requests"] = len(self._by_rid) + self._inbox.qsize()
+        for k, v in list(self.engine.stats.items()):
+            if isinstance(v, (int, float)):
+                out[f"engine_{k}"] = v
+        if self.admission is not None:
+            for k, v in self.admission.snapshot().items():
+                out[f"admission_{k}"] = v
+        return out
+
+    # -- loop thread --------------------------------------------------------
+
+    def _run(self) -> None:
+        eng = self.engine
+        while True:
+            self._wake.clear()
+            self._drain_inbox()
+            self._apply_cancels_and_deadlines()
+            if self._stop.is_set():
+                break
+            busy = False
+            if eng.has_work() or eng._inflight:
+                busy = eng.pipeline_tick()
+                # A long window may have carried requests past their
+                # deadlines; apply before the next dispatch extends them.
+                self._apply_cancels_and_deadlines()
+            if not busy and self._inbox.empty() and not self._stop.is_set():
+                self._wake.wait(self.idle_wait_s)
+        # Shutdown: drain device state so nothing is mid-write, then fail
+        # the survivors loudly.
+        eng._flush_inflight()
+        for req in list(self._by_rid.values()):
+            if req.rid is not None:
+                eng.cancel(req.rid)
+            self._terminal(req, "error", reason="shutdown")
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            self._terminal(req, "error", reason="shutdown")
+
+    def _drain_inbox(self) -> None:
+        eng = self.engine
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancel_requested:
+                self._terminal(req, "cancelled")
+                continue
+            now = self._clock()
+            if req.deadline is not None and now >= req.deadline:
+                self._terminal(req, "expired")
+                continue
+            try:
+                req.rid = eng.submit(req.prompt, req.max_new)
+            except ValueError as e:  # pre-validated; belt and suspenders
+                self._terminal(req, "error", reason=str(e))
+                continue
+            req.status = "active"
+            self._by_rid[req.rid] = req
+
+    def _apply_cancels_and_deadlines(self) -> None:
+        eng = self.engine
+        now = self._clock()
+        for rid, req in list(self._by_rid.items()):
+            if req.status in TERMINAL_STATUSES:
+                continue
+            status = None
+            if req.cancel_requested:
+                status = "cancelled"
+            elif req.deadline is not None and now >= req.deadline:
+                status = "expired"
+            if status is None:
+                continue
+            # cancel() may flush the queue; the flush can FINISH this
+            # request (tokens stream, _on_finish sends the done terminal)
+            # — then cancellation lost the race and there is nothing to do.
+            if eng.cancel(rid):
+                self._terminal(req, status)
+
+    # -- engine hooks (loop thread) ----------------------------------------
+
+    def _on_token(self, rid: int, tok: int) -> None:
+        req = self._by_rid.get(rid)
+        if req is None:
+            return
+        req.tokens.append(tok)
+        with self._lock:
+            self.counters["tokens_streamed"] += 1
+        req.out_q.put(("token", tok))
+
+    def _on_finish(self, rid: int, out: List[int]) -> None:
+        req = self._by_rid.get(rid)
+        if req is None:
+            return
+        req.tokens = list(out)  # authoritative (== concatenated stream)
+        self._terminal(req, "done")
+
+    # -- terminal bookkeeping (loop thread) --------------------------------
+
+    _COUNTER_FOR = {
+        "done": "completed", "cancelled": "cancelled",
+        "expired": "expired", "error": "errors",
+    }
+
+    def _terminal(self, req: FrontendRequest, status: str, **info: Any) -> None:
+        if req.status in TERMINAL_STATUSES:
+            return
+        req.status = status
+        eng = self.engine
+        timing: Dict[str, float] = {}
+        if req.rid is not None:
+            timing = eng.timing_summary(req.rid)
+            self._by_rid.pop(req.rid, None)
+            # Bound long-lived growth: the loop owns delivery, the engine
+            # need not keep per-request state past the terminal event.
+            eng.req_timing.pop(req.rid, None)
+            eng.finished.pop(req.rid, None)
+            eng.cancelled.discard(req.rid)
+        info.update(timing)
+        info["n_tokens"] = len(req.tokens)
+        req.info = info
+        tpot = None
+        if (
+            status == "done"
+            and len(req.tokens) > 1
+            and "ttft_s" in timing
+            and "e2e_s" in timing
+        ):
+            tpot = (timing["e2e_s"] - timing["ttft_s"]) / (len(req.tokens) - 1)
+            info["tpot_s"] = tpot
+        if self.admission is not None and req.ticket is not None:
+            self.admission.release(req.ticket, tpot_s=tpot)
+        with self._lock:
+            self.counters[self._COUNTER_FOR[status]] += 1
+        if self.bus is not None:
+            self.bus.emit(f"req_{status}", **info)
+        req.out_q.put(("end", status, info))

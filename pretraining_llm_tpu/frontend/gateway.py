@@ -1,0 +1,314 @@
+"""HTTP/SSE serving gateway over the EngineLoop — stdlib only.
+
+A deliberately small, dependency-free frontend (http.server's
+ThreadingHTTPServer): one handler thread per connection blocks on its
+request's stream queue while the single engine-loop thread does all
+device work. Endpoints:
+
+  POST /v1/generate   JSON in -> full JSON response, or SSE token
+                      streaming when ``"stream": true`` (one
+                      ``data: {...}`` event per committed token, then a
+                      terminal ``data: {"done": ...}`` and
+                      ``data: [DONE]``);
+  GET  /healthz       liveness + queue gauges;
+  GET  /metrics       Prometheus text exposition (the observability
+                      exporter's renderer) of loop/engine/admission/HTTP
+                      counters.
+
+Request schema (unknown keys are a 400 — a typo'd knob must not be
+silently ignored):
+
+  {"prompt": [1, 2, 3] | "text...",   # token ids, or text with a tokenizer
+   "max_new_tokens": 32,              # required positive int
+   "stream": false,                   # SSE streaming
+   "deadline_s": 2.5}                 # optional per-request deadline
+
+Status mapping: validation error 400, backpressure 429 (+ Retry-After),
+infeasible/missed deadline 504, client-cancelled 499, engine failure 500.
+The body always carries the lifecycle latencies the engine measured
+(queue_wait_s / ttft_s / e2e_s).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from pretraining_llm_tpu.frontend.admission import (
+    RejectedBusy,
+    RejectedInfeasible,
+)
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.observability.export import prometheus_lines
+
+_MAX_BODY_BYTES = 16 * 1024 * 1024
+_REQUEST_KEYS = {"prompt", "max_new_tokens", "stream", "deadline_s"}
+
+
+class _BadRequest(Exception):
+    pass
+
+
+class ServingGateway:
+    """Owns the HTTP server; ``loop`` must already be started.
+
+    ``encode``/``decode`` (optional) let clients send/receive text instead
+    of token ids. ``port=0`` binds an ephemeral port (tests); read it back
+    from ``.port``.
+    """
+
+    def __init__(
+        self,
+        loop: EngineLoop,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        encode: Optional[Callable[[str], Any]] = None,
+        decode: Optional[Callable[[Any], str]] = None,
+        default_deadline_s: float = 0.0,
+    ) -> None:
+        self.loop = loop
+        self.encode = encode
+        self.decode = decode
+        self.default_deadline_s = float(default_deadline_s)
+        self._counters_lock = threading.Lock()
+        self.http_counters: Dict[str, int] = {}
+        gateway = self
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        class _Handler(_GatewayHandler):
+            pass
+
+        _Handler.gateway = gateway
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "ServingGateway":
+        """Serve on a background thread (scripts serve_forever inline)."""
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="gateway", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def count_response(self, code: int) -> None:
+        with self._counters_lock:
+            key = f"http_responses_{code}"
+            self.http_counters[key] = self.http_counters.get(key, 0) + 1
+            self.http_counters["http_requests_total"] = (
+                self.http_counters.get("http_requests_total", 0) + 1
+            )
+
+    def metrics_text(self) -> str:
+        merged: Dict[str, float] = dict(self.loop.metrics())
+        with self._counters_lock:
+            merged.update(self.http_counters)
+        return prometheus_lines(merged, prefix="pllm_serving_")
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    gateway: ServingGateway  # installed per-subclass by ServingGateway
+    protocol_version = "HTTP/1.1"
+
+    # Route server chatter away from stderr; the gateway is not a log.
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_json(self, code: int, payload: Dict[str, Any], **headers: str) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k.replace("_", "-"), v)
+        self.end_headers()
+        self.wfile.write(body)
+        self.gateway.count_response(code)
+
+    def _read_json_body(self) -> Dict[str, Any]:
+        length = self.headers.get("Content-Length")
+        if length is None:
+            raise _BadRequest("missing Content-Length")
+        try:
+            n = int(length)
+        except ValueError:
+            raise _BadRequest(f"bad Content-Length {length!r}")
+        if n > _MAX_BODY_BYTES:
+            raise _BadRequest(f"body too large ({n} bytes)")
+        try:
+            payload = json.loads(self.rfile.read(n).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _BadRequest(f"invalid JSON body: {e}")
+        if not isinstance(payload, dict):
+            raise _BadRequest("body must be a JSON object")
+        return payload
+
+    # -- GET ----------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        if self.path == "/healthz":
+            m = self.gateway.loop.metrics()
+            self._send_json(200, {
+                "status": "ok",
+                "active_requests": m.get("active_requests", 0),
+                "completed": m.get("completed", 0),
+            })
+        elif self.path == "/metrics":
+            body = self.gateway.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            self.gateway.count_response(200)
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    # -- POST /v1/generate --------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/generate":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        gw = self.gateway
+        try:
+            payload = self._read_json_body()
+            prompt, max_new, stream, deadline_s = self._parse_request(payload)
+        except _BadRequest as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            req = gw.loop.submit(prompt, max_new, deadline_s=deadline_s)
+        except ValueError as e:
+            # The engine's submit-time validation: the 4xx that replaces a
+            # downstream shape error.
+            self._send_json(400, {"error": str(e)})
+            return
+        except RejectedBusy as e:
+            self._send_json(
+                429, {"error": f"overloaded: {e.reason}"},
+                Retry_After=f"{max(1, round(e.retry_after_s))}",
+            )
+            return
+        except RejectedInfeasible as e:
+            self._send_json(
+                504, {"error": f"deadline cannot be met: {e.reason}"}
+            )
+            return
+        if stream:
+            self._respond_sse(req)
+        else:
+            self._respond_full(req)
+
+    def _parse_request(self, payload: Dict[str, Any]):
+        unknown = set(payload) - _REQUEST_KEYS
+        if unknown:
+            raise _BadRequest(
+                f"unknown request keys {sorted(unknown)}; expected subset "
+                f"of {sorted(_REQUEST_KEYS)}"
+            )
+        if "prompt" not in payload:
+            raise _BadRequest("missing 'prompt'")
+        if "max_new_tokens" not in payload:
+            raise _BadRequest("missing 'max_new_tokens'")
+        prompt = payload["prompt"]
+        if isinstance(prompt, str):
+            if self.gateway.encode is None:
+                raise _BadRequest(
+                    "text prompts need a tokenizer; this gateway accepts "
+                    "token-id lists only"
+                )
+            prompt = list(self.gateway.encode(prompt))
+        elif not isinstance(prompt, list):
+            raise _BadRequest("'prompt' must be a string or a list of ints")
+        max_new = payload["max_new_tokens"]
+        if isinstance(max_new, bool) or not isinstance(max_new, int):
+            raise _BadRequest("'max_new_tokens' must be an integer")
+        stream = payload.get("stream", False)
+        if not isinstance(stream, bool):
+            raise _BadRequest("'stream' must be a boolean")
+        deadline_s = payload.get("deadline_s")
+        if deadline_s is not None:
+            if isinstance(deadline_s, bool) or not isinstance(
+                deadline_s, (int, float)
+            ):
+                raise _BadRequest("'deadline_s' must be a number")
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise _BadRequest("'deadline_s' must be > 0")
+        elif self.gateway.default_deadline_s > 0:
+            deadline_s = self.gateway.default_deadline_s
+        return prompt, max_new, stream, deadline_s
+
+    _STATUS_CODE = {"done": 200, "expired": 504, "cancelled": 499, "error": 500}
+
+    def _respond_full(self, req: Any) -> None:
+        status, tokens, info = req.result()
+        body: Dict[str, Any] = {"status": status, "tokens": tokens, **info}
+        if status != "done":
+            body["error"] = {
+                "expired": "deadline exceeded during generation",
+                "cancelled": "request cancelled",
+                "error": f"engine failure: {info.get('reason', 'unknown')}",
+            }[status]
+        if self.gateway.decode is not None:
+            body["text"] = self.gateway.decode(tokens)
+        self._send_json(self._STATUS_CODE[status], body)
+
+    def _respond_sse(self, req: Any) -> None:
+        gw = self.gateway
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        code = 200
+        try:
+            i = 0
+            for ev in req.events():
+                if ev[0] == "token":
+                    self._sse_data({"token": ev[1], "index": i})
+                    i += 1
+                else:  # ("end", status, info)
+                    _, status, info = ev
+                    final: Dict[str, Any] = {
+                        "done": True, "status": status, **info
+                    }
+                    if gw.decode is not None:
+                        final["text"] = gw.decode(req.tokens)
+                    self._sse_data(final)
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                    code = self._STATUS_CODE[status]
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # Client went away mid-stream: release the row and pool blocks
+            # now rather than decoding tokens nobody will read.
+            gw.loop.cancel(req)
+            code = 499
+        gw.count_response(code)
+
+    def _sse_data(self, obj: Dict[str, Any]) -> None:
+        self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+        self.wfile.flush()

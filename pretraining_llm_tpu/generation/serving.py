@@ -42,7 +42,7 @@ import dataclasses
 import time
 import warnings
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -277,6 +277,22 @@ class ServingEngine:
         self.rows: List[Optional[_Request]] = [None] * self.max_batch
         self.waiting: deque = deque()
         self.finished: Dict[int, List[int]] = {}
+        # Requests aborted via cancel() — they never land in `finished`.
+        self.cancelled: set = set()
+        # Per-request lifecycle timestamps (monotonic seconds): submit_s,
+        # admit_s (first row claim; preemption re-admits keep the first),
+        # first_token_s (first COMMITTED output token), end_s. The online
+        # frontend and the offline `serve.py --output` JSONL both read
+        # these via timing_summary(); long-lived callers pop entries at
+        # request end to bound growth.
+        self.req_timing: Dict[int, Dict[str, float]] = {}
+        self._now = time.monotonic
+        # Streaming hooks (frontend/engine_loop.py): called synchronously
+        # on the scheduling thread as tokens COMMIT (reap time in the
+        # pipelined scheduler) and as requests finish. None = offline
+        # batch mode.
+        self.on_token: Optional[Callable[[int, int], None]] = None
+        self.on_finish: Optional[Callable[[int, List[int]], None]] = None
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
@@ -297,18 +313,46 @@ class ServingEngine:
 
     # -- public API --------------------------------------------------------
 
-    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int) -> int:
-        """Queue a request; returns its id. Fails fast if the request can
-        never fit (prompt + generation must fit max_seq AND the pool)."""
+    def validate_request(
+        self, prompt_ids: Sequence[int], max_new_tokens: Any
+    ) -> int:
+        """Everything submit() checks, without queueing anything — clear
+        ``ValueError``s AT SUBMIT TIME (the gateway maps them to 400)
+        instead of a shape/gather failure later inside dispatch. Reads
+        only construction-time constants, so concurrent gateway threads
+        may call it while the engine thread runs. Returns the normalized
+        integer ``max_new_tokens``."""
+        try:
+            max_new = int(max_new_tokens)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"max_new_tokens must be an integer, got "
+                f"{type(max_new_tokens).__name__}"
+            )
+        if max_new != max_new_tokens:  # reject 2.5 -> 2 silent truncation
+            raise ValueError(
+                f"max_new_tokens must be an integer, got {max_new_tokens!r}"
+            )
         p = len(prompt_ids)
         if p == 0:
             raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        total = p + max_new_tokens
+        ids = np.asarray(prompt_ids)
+        if ids.dtype.kind not in "iu":
+            raise ValueError(
+                f"prompt must be integer token ids, got dtype {ids.dtype}"
+            )
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= self.cfg.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.cfg.vocab_size}); "
+                f"got range [{lo}, {hi}]"
+            )
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        total = p + max_new
         if total > self.max_seq:
             raise ValueError(
-                f"prompt({p}) + max_new({max_new_tokens}) = {total} exceeds "
+                f"prompt({p}) + max_new({max_new}) = {total} exceeds "
                 f"max_seq={self.max_seq}"
             )
         if paged.required_blocks(total, self.block_size) > self.alloc.n_blocks - 1:
@@ -316,10 +360,77 @@ class ServingEngine:
                 f"request needs {paged.required_blocks(total, self.block_size)} "
                 f"blocks; the pool only has {self.alloc.n_blocks - 1}"
             )
+        return max_new
+
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int) -> int:
+        """Queue a request; returns its id. Fails fast if the request can
+        never fit (prompt + generation must fit max_seq AND the pool)."""
+        max_new = self.validate_request(prompt_ids, max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
-        self.waiting.append(_Request(rid, list(prompt_ids), int(max_new_tokens)))
+        self.req_timing[rid] = {"submit_s": self._now()}
+        self.waiting.append(_Request(rid, [int(t) for t in prompt_ids], max_new))
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a live request, releasing its row and pool blocks
+        immediately. A waiting request unlinks with no device work; a
+        running one first FLUSHES the in-flight window queue — windows
+        already dispatched keep writing K/V into the victim's pages on
+        device, so freeing those blocks before the drain would hand
+        live-written pages to the next admission — then releases the row.
+        Tokens the flush commits still stream through ``on_token``; the
+        caller owns the terminal notification. Returns False when the
+        request is unknown or already finished (cancellation lost the
+        race — its output is in ``finished``)."""
+        for req in self.waiting:
+            if req.rid == rid:
+                self.waiting.remove(req)
+                self._mark_cancelled(rid)
+                return True
+        req = next(
+            (r for r in self.rows if r is not None and r.rid == rid), None
+        )
+        if req is None:
+            return False
+        self._flush_inflight()
+        # The drain may have finished the request (its surviving tokens
+        # were committed and streamed) — then there is nothing to cancel.
+        if req.row is None or self.rows[req.row] is not req:
+            return False
+        # A victim admitted this very boundary may still hold its first
+        # token on device; resolving it can itself finish the request.
+        self._resolve_first(req)
+        if req.row is None:
+            return False
+        self._release_row(req)
+        self._mark_cancelled(rid)
+        return True
+
+    def _mark_cancelled(self, rid: int) -> None:
+        self.cancelled.add(rid)
+        self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+        t = self.req_timing.get(rid)
+        if t is not None:
+            t["end_s"] = self._now()
+
+    def timing_summary(self, rid: int) -> Dict[str, float]:
+        """Lifecycle latencies (seconds) for a request: ``queue_wait_s``
+        (submit -> first row claim), ``ttft_s`` (submit -> first committed
+        output token), ``e2e_s`` (submit -> finish/cancel). Only phases
+        the request actually reached appear."""
+        t = self.req_timing.get(rid)
+        if not t:
+            return {}
+        out: Dict[str, float] = {}
+        sub = t["submit_s"]
+        if "admit_s" in t:
+            out["queue_wait_s"] = t["admit_s"] - sub
+        if "first_token_s" in t:
+            out["ttft_s"] = t["first_token_s"] - sub
+        if "end_s" in t:
+            out["e2e_s"] = t["end_s"] - sub
+        return out
 
     @property
     def n_active(self) -> int:
@@ -471,47 +582,59 @@ class ServingEngine:
 
     def _run_pipelined(self) -> Dict[int, List[int]]:
         assert not self._inflight, "re-entrant run()"
-        depth = self.pipeline_depth
         while self.has_work() or self._inflight:
-            self._admit(defer=True)
-            if self.n_active:
-                if self.spec_k:
-                    # Worst case every queued round and the new one
-                    # advance the device frontier by k+1 past the
-                    # committed seq_lens — pre-ensure the whole horizon
-                    # so no flush can land between dispatch and reap.
-                    k = self.spec_k
-                    self._ensure_write_pages(
-                        horizon=(k + 1) * (len(self._inflight) + 1)
-                    )
-                    if self.n_active:
-                        self._dispatch_spec_round()
-                else:
-                    n = self._window_len()
-                    # ONE window length for both the page horizon and the
-                    # dispatch: ensure_write_pages may flush/preempt
-                    # (which only shrinks the remaining budget), and a
-                    # dispatch longer than the ensured horizon would
-                    # scratch-redirect live writes — computing n once
-                    # makes that impossible by construction. ``prealloc``
-                    # opportunistically extends rows toward the full
-                    # in-flight horizon (n * depth slots) from the free
-                    # list, so later dispatches rarely need new pages at
-                    # all — a page flush between an already-dispatched
-                    # window and its reap becomes the exception.
-                    self._ensure_write_pages(
-                        horizon=n, prealloc=n * (depth - 1)
-                    )
-                    if self.n_active:
-                        self._dispatch_window(n)
-            # Reap the oldest window once the queue exceeds its depth —
-            # by then it has had `depth` windows of device time to finish,
-            # so the readback rarely blocks — and drain outright when
-            # nothing is running (end of stream, or everyone preempted).
-            while (len(self._inflight) > depth
-                   or (self._inflight and not self.n_active)):
-                self._reap_window(self._inflight.popleft())
+            self.pipeline_tick()
         return self.finished
+
+    def pipeline_tick(self) -> bool:
+        """One turn of the deep-pipelined scheduler: admit waiting
+        requests, dispatch at most one window, reap windows beyond the
+        queue depth. ``run(pipeline=True)`` is exactly this in a loop;
+        the online frontend (frontend/engine_loop.py) calls it directly
+        so submissions, cancellations and deadline checks can land
+        BETWEEN scheduler turns of a long-lived engine. Returns True
+        while device work remains dispatched or runnable (False = the
+        engine is fully idle)."""
+        depth = self.pipeline_depth
+        self._admit(defer=True)
+        if self.n_active:
+            if self.spec_k:
+                # Worst case every queued round and the new one
+                # advance the device frontier by k+1 past the
+                # committed seq_lens — pre-ensure the whole horizon
+                # so no flush can land between dispatch and reap.
+                k = self.spec_k
+                self._ensure_write_pages(
+                    horizon=(k + 1) * (len(self._inflight) + 1)
+                )
+                if self.n_active:
+                    self._dispatch_spec_round()
+            else:
+                n = self._window_len()
+                # ONE window length for both the page horizon and the
+                # dispatch: ensure_write_pages may flush/preempt
+                # (which only shrinks the remaining budget), and a
+                # dispatch longer than the ensured horizon would
+                # scratch-redirect live writes — computing n once
+                # makes that impossible by construction. ``prealloc``
+                # opportunistically extends rows toward the full
+                # in-flight horizon (n * depth slots) from the free
+                # list, so later dispatches rarely need new pages at
+                # all — a page flush between an already-dispatched
+                # window and its reap becomes the exception.
+                self._ensure_write_pages(
+                    horizon=n, prealloc=n * (depth - 1)
+                )
+                if self.n_active:
+                    self._dispatch_window(n)
+        # Reap the oldest window once the queue exceeds its depth —
+        # by then it has had `depth` windows of device time to finish,
+        # so the readback rarely blocks — and drain outright when
+        # nothing is running (end of stream, or everyone preempted).
+        while (len(self._inflight) > depth
+               or (self._inflight and not self.n_active)):
+            self._reap_window(self._inflight.popleft())
+        return bool(self._inflight) or self.has_work()
 
     def _dispatch_window(self, n: int) -> None:
         """Enqueue one ``steps_per_sched``-step decode window WITHOUT
@@ -681,11 +804,25 @@ class ServingEngine:
             if advance_seq:
                 self.seq_lens[row] += 1
             req.generated.append(tok)
+            self._emit_token(req, tok)
             self.tokens[row] = tok
             self.stats["tokens"] += 1
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
                 break  # surplus tokens for this row are discarded
+
+    def _emit_token(self, req: _Request, tok: int) -> None:
+        """Post-append commit hook: first-token timestamp + the streaming
+        callback. The stop token is bookkeeping, not output (``_finish``
+        strips it), so it is never streamed; across preemptions the
+        concatenated stream equals the final ``prefix + generated``
+        output exactly (preempted tokens streamed in their first
+        incarnation, re-decoded ones arrive as prompt, not output)."""
+        t = self.req_timing.get(req.rid)
+        if t is not None and tok != self.stop_token:
+            t.setdefault("first_token_s", self._now())
+        if self.on_token is not None and tok != self.stop_token:
+            self.on_token(req.rid, tok)
 
     def _flush_inflight(self) -> None:
         """Reconciliation: synchronously drain EVERY in-flight window,
@@ -708,6 +845,7 @@ class ServingEngine:
         req.pending_first = None
         tok = int(np.asarray(arr)[i])
         req.generated.append(tok)
+        self._emit_token(req, tok)
         if req.row is not None:
             self.tokens[req.row] = tok
             if tok == self.stop_token or len(req.generated) >= req.max_new:
@@ -790,6 +928,11 @@ class ServingEngine:
             req.admit_order = self._admit_counter
             self._admit_counter += 1
             self.stats["admissions"] += 1
+            t = self.req_timing.get(req.rid)
+            if t is not None:
+                # setdefault: a preempted request's re-admission must not
+                # move its queue-wait mark.
+                t.setdefault("admit_s", self._now())
             self.rows[row] = req  # claim now: n_active sees earlier admits
             self.tables[row, :] = 0
             self.tables[row, : len(blocks)] = blocks
@@ -830,6 +973,7 @@ class ServingEngine:
         for i, req in enumerate(admits):
             tok = int(toks[i])
             req.generated.append(tok)
+            self._emit_token(req, tok)
             self.tokens[req.row] = tok
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
@@ -988,7 +1132,12 @@ class ServingEngine:
         if self.stop_token is not None and out and out[-1] == self.stop_token:
             out = out[:-1]
         self.finished[req.rid] = out
+        t = self.req_timing.get(req.rid)
+        if t is not None:
+            t["end_s"] = self._now()
         self._release_row(req)
+        if self.on_finish is not None:
+            self.on_finish(req.rid, out)
 
     def _release_row(self, req: _Request) -> None:
         row = req.row

@@ -46,6 +46,14 @@ EVENT_KINDS = (
     "failure",        # step loop raised: step, error
     "device_memory",  # HBM sample: per-device bytes_in_use/peak
     "fault_injected", # drill fault fired: kind, step
+    # Serving-frontend request lifecycle (frontend/engine_loop.py). The
+    # terminal kinds carry queue_wait_s/ttft_s/e2e_s + n_tokens, so the
+    # event stream doubles as the per-request serving audit log.
+    "req_submit",     # accepted past validation+admission: n_prompt, max_new
+    "req_done",       # generated to completion (HTTP 200)
+    "req_cancelled",  # client cancelled / disconnected (HTTP 499)
+    "req_expired",    # deadline passed mid-flight (HTTP 504)
+    "req_error",      # engine failure or shutdown (HTTP 500)
 )
 
 

@@ -62,3 +62,107 @@ assert st["host_blocked_s"] >= 0.0, st
 print(f"serving smoke ok: {st['tokens']} tokens, {st['windows']} windows, "
       f"host_blocked_s={st['host_blocked_s']:.4f}")
 EOF
+
+# Gateway gate: the ONLINE path end-to-end over real HTTP. A tiny random-
+# init model behind EngineLoop + ServingGateway serves 4 concurrent
+# requests — one SSE-streaming, one cancelled mid-generation by dropping
+# the connection — all must terminate, and /metrics must report the
+# request counters (completed + cancelled) in Prometheus text format.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import dataclasses, json, socket, threading, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+eng = ServingEngine(params, cfg, max_batch=4, n_blocks=32, block_size=8,
+                    temperature=0.0, steps_per_sched=2, pipeline_depth=2)
+loop = EngineLoop(eng, admission=AdmissionController(max_queue_depth=8))
+gw = ServingGateway(loop, port=0)
+loop.start(); gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+def post(payload):
+    req = urllib.request.Request(
+        f"{base}/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+results = {}
+def full(name, n):
+    results[name] = post({"prompt": [1, 2, 3, int(n)], "max_new_tokens": 8})
+def sse(name):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": [5, 6, 7], "max_new_tokens": 8,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    toks, final = [], None
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for line in r:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            ev = json.loads(line[6:])
+            if ev.get("done"): final = ev
+            elif "token" in ev: toks.append(ev["token"])
+    results[name] = {"tokens": toks, "final": final}
+def cancelled(name):
+    # Open a streaming request, read one token, drop the socket: the
+    # gateway must cancel the request and free its row/pool blocks.
+    s = socket.create_connection(("127.0.0.1", gw.port), timeout=120)
+    body = json.dumps({"prompt": [9, 9, 9], "max_new_tokens": 48,
+                       "stream": True}).encode()
+    s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+    buf = b""
+    while b"data: " not in buf:
+        chunk = s.recv(4096)
+        assert chunk, buf
+        buf += chunk
+    s.close()
+    results[name] = {"cancel_sent": True}
+
+threads = [threading.Thread(target=full, args=("a", 1)),
+           threading.Thread(target=full, args=("b", 2)),
+           threading.Thread(target=sse, args=("c",)),
+           threading.Thread(target=cancelled, args=("d",))]
+for t in threads: t.start()
+for t in threads: t.join(timeout=180)
+assert not any(t.is_alive() for t in threads), "a gateway request hung"
+
+assert results["a"]["status"] == "done" and results["a"]["n_tokens"] == 8, results["a"]
+assert results["b"]["status"] == "done" and results["b"]["n_tokens"] == 8, results["b"]
+assert results["c"]["final"]["status"] == "done", results["c"]
+assert len(results["c"]["tokens"]) == 8, results["c"]
+
+# The dropped connection must surface as a cancellation (or a completed
+# request if the drop raced the final token) — and every row/block must
+# be back: allocator idle == n_blocks - 1 (block 0 reserved).
+import time
+for _ in range(200):
+    m = loop.metrics()
+    if m["active_requests"] == 0 and eng.alloc.available == 32 - 1:
+        break
+    time.sleep(0.05)
+assert eng.alloc.available == 32 - 1, eng.alloc.available
+assert m["completed"] + m["cancelled"] == 4, m
+
+with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+    assert json.loads(r.read())["status"] == "ok"
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+assert "pllm_serving_completed" in text, text[:400]
+assert "pllm_serving_submitted" in text, text[:400]
+assert "pllm_serving_http_requests_total" in text, text[:400]
+
+gw.stop(); loop.stop()
+print(f"gateway smoke ok: {m}")
+EOF

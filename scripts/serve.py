@@ -13,6 +13,13 @@ Example:
   python scripts/serve.py --model_path checkpoints \
       --input_file prompts.txt --max_new_tokens 100 \
       --max_batch 8 --steps_per_sched 8 --output results.jsonl
+
+With ``--http`` the same engine goes ONLINE: a continuous engine loop
+(frontend.EngineLoop) plus a stdlib HTTP/SSE gateway serving
+POST /v1/generate, GET /healthz and GET /metrics until interrupted:
+
+  python scripts/serve.py --model_path checkpoints --http --port 8000
+  curl -s localhost:8000/v1/generate -d '{"prompt": "hi", "max_new_tokens": 16}'
 """
 
 from __future__ import annotations
@@ -34,8 +41,8 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model_path", required=True,
                         help="checkpoint dir (or a step-N dir)")
-    parser.add_argument("--input_file", required=True,
-                        help="one prompt per line")
+    parser.add_argument("--input_file", default="",
+                        help="one prompt per line (required unless --http)")
     parser.add_argument("--max_new_tokens", type=int, default=100)
     parser.add_argument("--max_batch", type=int, default=8,
                         help="concurrent decode rows (the compiled width)")
@@ -76,7 +83,28 @@ def main() -> None:
                         help="override the checkpoint's tokenizer name")
     parser.add_argument("--output", default="",
                         help="results JSONL path (default: stdout)")
+    parser.add_argument("--http", action="store_true",
+                        help="serve an HTTP/SSE gateway instead of draining "
+                        "an offline prompt file")
+    parser.add_argument("--host", default=None,
+                        help="gateway bind host (default: config)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="gateway bind port, 0 = ephemeral (default: "
+                        "config)")
+    parser.add_argument("--max_queue_depth", type=int, default=None,
+                        help="backpressure: max in-system requests before "
+                        "429 (default: config)")
+    parser.add_argument("--max_outstanding_tokens", type=int, default=None,
+                        help="backpressure: outstanding prompt+max_new token "
+                        "budget, 0 = unlimited (default: config)")
+    parser.add_argument("--default_deadline_s", type=float, default=None,
+                        help="deadline applied to requests that send none, "
+                        "0 = none (default: config)")
+    parser.add_argument("--events", default="",
+                        help="(--http) request-lifecycle events JSONL path")
     args = parser.parse_args()
+    if not args.http and not args.input_file:
+        parser.error("--input_file is required unless --http is set")
 
     from pretraining_llm_tpu.data.tokenizer import get_tokenizer
     from pretraining_llm_tpu.generation.generate import (
@@ -84,10 +112,12 @@ def main() -> None:
     )
     from pretraining_llm_tpu.generation.serving import ServingEngine
 
-    with open(args.input_file) as f:
-        texts = [ln.rstrip("\r\n") for ln in f if ln.strip()]
-    if not texts:
-        raise SystemExit(f"no prompts in {args.input_file}")
+    texts = []
+    if args.input_file:
+        with open(args.input_file) as f:
+            texts = [ln.rstrip("\r\n") for ln in f if ln.strip()]
+        if not texts:
+            raise SystemExit(f"no prompts in {args.input_file}")
 
     params, cfg = load_model_for_inference(args.model_path, use_ema=args.ema)
     params = cast_params_for_inference(params, cfg.model)
@@ -112,6 +142,11 @@ def main() -> None:
         admit_batch=args.admit_batch or cfg.serving.admit_batch,
         **spec,
     )
+
+    if args.http:
+        _serve_http(args, cfg, eng, enc)
+        return
+
     rids = {}
     rejected = []
     for i, text in enumerate(texts):
@@ -132,12 +167,17 @@ def main() -> None:
     try:
         for rid in sorted(rids, key=rids.get):
             toks = out[rid]
-            sink.write(json.dumps({
+            record = {
                 "index": rids[rid],
                 "prompt": texts[rids[rid]],
                 "output": enc.decode(toks),
                 "n_tokens": len(toks),
-            }) + "\n")
+            }
+            # Per-request lifecycle latencies: how long the request sat in
+            # the waiting queue, time to its first committed token, and
+            # submit-to-finish — the offline view of the serving SLOs.
+            record.update(eng.timing_summary(rid))
+            sink.write(json.dumps(record) + "\n")
     finally:
         if sink is not sys.stdout:
             sink.close()
@@ -147,6 +187,55 @@ def main() -> None:
         f"({n_tok / dt:.1f} tok/s) — stats {eng.stats}",
         file=sys.stderr,
     )
+
+
+def _serve_http(args, cfg, eng, enc) -> None:
+    """Run the online gateway until interrupted (Ctrl-C)."""
+    from pretraining_llm_tpu.frontend.admission import AdmissionController
+    from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+    from pretraining_llm_tpu.frontend.gateway import ServingGateway
+    from pretraining_llm_tpu.observability.events import EventBus
+
+    fc = cfg.frontend
+
+    def pick(cli_val, cfg_val):
+        return cfg_val if cli_val is None else cli_val
+
+    bus = EventBus(jsonl_path=args.events) if args.events else None
+    admission = AdmissionController(
+        max_queue_depth=pick(args.max_queue_depth, fc.max_queue_depth),
+        max_outstanding_tokens=pick(
+            args.max_outstanding_tokens, fc.max_outstanding_tokens
+        ),
+        retry_after_s=fc.retry_after_s,
+        shed_infeasible=fc.shed_infeasible,
+    )
+    loop = EngineLoop(
+        eng, admission=admission, bus=bus, idle_wait_s=fc.idle_wait_s
+    ).start()
+    gateway = ServingGateway(
+        loop,
+        host=pick(args.host, fc.host),
+        port=pick(args.port, fc.port),
+        encode=enc.encode_ordinary,
+        decode=enc.decode,
+        default_deadline_s=pick(args.default_deadline_s, fc.default_deadline_s),
+    )
+    print(
+        f"[serve] gateway listening on http://{gateway._server.server_address[0]}"
+        f":{gateway.port} — POST /v1/generate, GET /healthz, GET /metrics",
+        file=sys.stderr,
+    )
+    try:
+        gateway.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gateway.stop()
+        loop.stop()
+        if bus is not None:
+            bus.close()
+        print(f"[serve] shut down — {loop.counters}", file=sys.stderr)
 
 
 if __name__ == "__main__":
