@@ -108,6 +108,11 @@ class EngineLoop:
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         self._inbox: "queue.Queue[FrontendRequest]" = queue.Queue()
+        # Guards the submit-side put against the shutdown drain: once the
+        # loop thread has drained the inbox (_drained), a late put would
+        # enqueue a request nothing will ever terminate.
+        self._inbox_lock = threading.Lock()
+        self._drained = False
         self._by_rid: Dict[int, FrontendRequest] = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -166,22 +171,33 @@ class EngineLoop:
             ticket = self.admission.try_admit(
                 len(prompt), max_new, deadline_s=deadline_s
             )
-        now = self._clock()
-        req = FrontendRequest(
-            prompt=[int(t) for t in prompt],
-            max_new=max_new,
-            deadline=(now + deadline_s) if deadline_s is not None else None,
-            submitted_s=now,
-            ticket=ticket,
-        )
-        with self._lock:
-            self.counters["submitted"] += 1
-        if self.bus is not None:
-            self.bus.emit(
-                "req_submit", n_prompt=len(req.prompt), max_new=max_new,
-                deadline_s=deadline_s,
+        try:
+            now = self._clock()
+            req = FrontendRequest(
+                prompt=[int(t) for t in prompt],
+                max_new=max_new,
+                deadline=(now + deadline_s) if deadline_s is not None else None,
+                submitted_s=now,
+                ticket=ticket,
             )
-        self._inbox.put(req)
+            with self._lock:
+                self.counters["submitted"] += 1
+            if self.bus is not None:
+                self.bus.emit(
+                    "req_submit", n_prompt=len(req.prompt), max_new=max_new,
+                    deadline_s=deadline_s,
+                )
+            with self._inbox_lock:
+                if self._drained:
+                    raise RuntimeError("EngineLoop is not running")
+                self._inbox.put(req)
+        except BaseException:
+            # The request never reached the inbox, so _terminal will never
+            # run for it — its admission budget must be returned here or
+            # the queue-depth slot leaks until restart.
+            if ticket is not None:
+                self.admission.release(ticket)
+            raise
         self._wake.set()
         return req
 
@@ -210,33 +226,57 @@ class EngineLoop:
 
     def _run(self) -> None:
         eng = self.engine
-        while True:
-            self._wake.clear()
-            self._drain_inbox()
-            self._apply_cancels_and_deadlines()
-            if self._stop.is_set():
-                break
-            busy = False
-            if eng.has_work() or eng._inflight:
-                busy = eng.pipeline_tick()
-                # A long window may have carried requests past their
-                # deadlines; apply before the next dispatch extends them.
+        failure: Optional[BaseException] = None
+        try:
+            while True:
+                self._wake.clear()
+                self._drain_inbox()
                 self._apply_cancels_and_deadlines()
-            if not busy and self._inbox.empty() and not self._stop.is_set():
-                self._wake.wait(self.idle_wait_s)
-        # Shutdown: drain device state so nothing is mid-write, then fail
-        # the survivors loudly.
-        eng._flush_inflight()
-        for req in list(self._by_rid.values()):
-            if req.rid is not None:
-                eng.cancel(req.rid)
-            self._terminal(req, "error", reason="shutdown")
-        while True:
+                if self._stop.is_set():
+                    break
+                busy = False
+                if eng.has_work() or eng._inflight:
+                    busy = eng.pipeline_tick()
+                    # A long window may have carried requests past their
+                    # deadlines; apply before the next dispatch extends them.
+                    self._apply_cancels_and_deadlines()
+                if not busy and self._inbox.empty() and not self._stop.is_set():
+                    self._wake.wait(self.idle_wait_s)
+        except BaseException as e:
+            failure = e
+            raise
+        finally:
+            # Runs on clean stop() AND when the engine (or a hook) raised:
+            # every outstanding request must get a terminal event, or the
+            # gateway threads blocked in result()/events() hang forever.
+            # _stop also makes submit() raise instead of enqueueing into a
+            # dead loop.
+            self._stop.set()
+            reason = (
+                "shutdown" if failure is None
+                else f"engine failure: {failure!r}"
+            )
             try:
-                req = self._inbox.get_nowait()
-            except queue.Empty:
-                break
-            self._terminal(req, "error", reason="shutdown")
+                # Drain device state so nothing is mid-write, then fail
+                # the survivors loudly.
+                eng._flush_inflight()
+            except Exception:
+                pass  # the engine is already broken; still fail survivors
+            for req in list(self._by_rid.values()):
+                try:
+                    if req.rid is not None:
+                        eng.cancel(req.rid)
+                except Exception:
+                    pass
+                self._terminal(req, "error", reason=reason)
+            with self._inbox_lock:
+                self._drained = True
+            while True:
+                try:
+                    req = self._inbox.get_nowait()
+                except queue.Empty:
+                    break
+                self._terminal(req, "error", reason=reason)
 
     def _drain_inbox(self) -> None:
         eng = self.engine

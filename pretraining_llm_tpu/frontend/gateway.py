@@ -140,6 +140,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            self.send_header("Connection", "close")
         for k, v in headers.items():
             self.send_header(k.replace("_", "-"), v)
         self.end_headers()
@@ -189,6 +191,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         if self.path != "/v1/generate":
+            # The body was never read: on a keep-alive connection the next
+            # pipelined request would be parsed from these body bytes, so
+            # close instead of corrupting the framing.
+            self.close_connection = True
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         gw = self.gateway
@@ -196,6 +202,9 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             payload = self._read_json_body()
             prompt, max_new, stream, deadline_s = self._parse_request(payload)
         except _BadRequest as e:
+            # Some rejections (missing/huge Content-Length) fire before the
+            # body is read — same unread-body framing hazard as above.
+            self.close_connection = True
             self._send_json(400, {"error": str(e)})
             return
         try:
@@ -215,6 +224,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self._send_json(
                 504, {"error": f"deadline cannot be met: {e.reason}"}
             )
+            return
+        except RuntimeError as e:
+            # EngineLoop stopped (or died) between the health check and the
+            # enqueue: the process is going away, tell the client to go
+            # elsewhere rather than killing the handler thread.
+            self.close_connection = True
+            self._send_json(503, {"error": str(e)})
             return
         if stream:
             self._respond_sse(req)
@@ -274,7 +290,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             }[status]
         if self.gateway.decode is not None:
             body["text"] = self.gateway.decode(tokens)
-        self._send_json(self._STATUS_CODE[status], body)
+        try:
+            self._send_json(self._STATUS_CODE[status], body)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # Client went away while we were blocked on the result. The
+            # request is already terminal by now, so cancel() is a no-op
+            # belt-and-suspenders; what matters is not letting the handler
+            # thread die with a traceback and counting the response as the
+            # 499 it effectively was (the 200 in _send_json was never
+            # counted — count_response comes after the failed write).
+            self.gateway.loop.cancel(req)
+            self.gateway.count_response(499)
+            self.close_connection = True
 
     def _respond_sse(self, req: Any) -> None:
         gw = self.gateway

@@ -337,6 +337,11 @@ class ServingEngine:
         if p == 0:
             raise ValueError("empty prompt")
         ids = np.asarray(prompt_ids)
+        if ids.ndim != 1:
+            raise ValueError(
+                f"prompt must be a flat list of token ids, got an array of "
+                f"shape {ids.shape}"
+            )
         if ids.dtype.kind not in "iu":
             raise ValueError(
                 f"prompt must be integer token ids, got dtype {ids.dtype}"

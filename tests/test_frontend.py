@@ -12,6 +12,7 @@ with the victim's row and pool blocks back in the allocator.
 import dataclasses
 import json
 import socket
+import struct
 import threading
 import time
 import urllib.error
@@ -114,6 +115,20 @@ def test_submit_validation_rejects_clearly(params):
         eng.submit([1] * 10, eng.max_seq)  # prompt + max_new > max_seq
     # Nothing was queued by any of the rejects.
     assert not eng.waiting and eng.stats["tokens"] == 0
+
+
+def test_submit_validation_rejects_nested_prompt(params):
+    """A nested-list prompt yields a 2-D integer array that used to slip
+    through the dtype/range checks and explode later (after admission had
+    already charged a slot); it must be a clear submit-time ValueError."""
+    eng = _engine(params)
+    with pytest.raises(ValueError, match="flat"):
+        eng.submit([[1], [2]], 4)
+    with pytest.raises(ValueError, match="flat"):
+        eng.submit(np.array([[1, 2], [3, 4]]), 4)
+    with pytest.raises(ValueError):
+        eng.submit([[1], [2, 3]], 4)  # ragged: rejected, message numpy's
+    assert not eng.waiting and not eng.req_timing
 
 
 def test_submit_validation_pool_capacity(params):
@@ -336,6 +351,54 @@ def test_engine_loop_shutdown_fails_inflight(params):
         loop.submit([1, 2], 4)
 
 
+def test_engine_loop_submit_failure_releases_ticket(params):
+    """A failure AFTER admission but before the request reaches the inbox
+    must hand the ticket back — otherwise each such request permanently
+    burns a queue-depth slot and the service wedges into all-429."""
+    eng = _engine(params)
+    adm = AdmissionController(max_queue_depth=1)
+
+    class _BoomBus:
+        def emit(self, *a, **k):
+            raise RuntimeError("bus exploded")
+
+    with EngineLoop(eng, admission=adm, bus=_BoomBus()) as loop:
+        with pytest.raises(RuntimeError, match="bus exploded"):
+            loop.submit([1, 2, 3], 4)
+        assert adm.live == 0 and adm.outstanding_tokens == 0
+        loop.bus = None  # the slot is usable again
+        assert loop.submit([1, 2, 3], 4).result(timeout=300)[0] == "done"
+
+
+@pytest.mark.filterwarnings(
+    # The loop re-raises the engine failure after delivering terminals, so
+    # the thread dies LOUDLY (threading.excepthook) — that is the point.
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_engine_loop_engine_failure_terminates_requests(params):
+    """If pipeline_tick raises, the loop thread must not die silently:
+    every outstanding request gets an error terminal (callers blocked in
+    result() wake up), tickets are released, and new submits raise
+    instead of enqueueing into a dead loop."""
+    eng = _engine(params)
+    adm = AdmissionController(max_queue_depth=8)
+
+    def boom():
+        raise RuntimeError("device on fire")
+
+    eng.pipeline_tick = boom
+    loop = EngineLoop(eng, admission=adm).start()
+    req = loop.submit(_prompts(1)[0], 8)
+    status, _, info = req.result(timeout=30)
+    assert status == "error"
+    assert "engine failure" in info["reason"]
+    assert "device on fire" in info["reason"]
+    assert adm.live == 0 and adm.outstanding_tokens == 0
+    with pytest.raises(RuntimeError):
+        loop.submit([1, 2], 4)
+    loop.stop()
+
+
 # -- admission controller ---------------------------------------------------
 
 
@@ -503,6 +566,107 @@ def test_gateway_validation_400s(params):
         with pytest.raises(urllib.error.HTTPError) as exc:
             urllib.request.urlopen(f"{g.base}/nope", timeout=30)
         assert exc.value.code == 404
+
+
+def test_gateway_nested_prompt_400_no_admission_leak(params):
+    """Regression: [[1],[2]] used to pass validation, charge an admission
+    slot, then blow up uncaught in submit — wedging a depth-1 service
+    into permanent 429. It must be a 400 with no slot consumed."""
+    adm = AdmissionController(max_queue_depth=1)
+    with _Gateway(params, adm=adm) as g:
+        for _ in range(3):  # each leaked slot would wedge depth=1
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(g.base, {"prompt": [[1], [2]], "max_new_tokens": 4})
+            assert exc.value.code == 400
+            assert "flat" in json.loads(exc.value.read())["error"]
+        assert adm.live == 0
+        status, body = _post(g.base, {"prompt": [1, 2], "max_new_tokens": 4})
+        assert status == 200 and body["status"] == "done"
+
+
+def _raw_http_exchange(port, request_bytes):
+    """Send raw bytes on a fresh connection; return (head, drained_to_eof)
+    where head is everything received and drained_to_eof says the server
+    closed the connection after responding."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=60)
+    try:
+        s.sendall(request_bytes)
+        s.settimeout(10)
+        buf = b""
+        while True:
+            try:
+                chunk = s.recv(4096)
+            except socket.timeout:
+                return buf, False
+            if not chunk:
+                return buf, True
+            buf += chunk
+    finally:
+        s.close()
+
+
+def test_gateway_unread_body_closes_connection(params):
+    """Keep-alive framing: error responses sent without reading the POST
+    body must close the connection — otherwise the next request on the
+    socket is parsed out of the leftover body bytes."""
+    body = json.dumps({"prompt": [1], "max_new_tokens": 4}).encode()
+    with _Gateway(params) as g:
+        # POST to an unknown route: 404 with the body never read.
+        raw = (
+            b"POST /nope HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        head, closed = _raw_http_exchange(g.gw.port, raw)
+        assert head.startswith(b"HTTP/1.1 404")
+        assert b"connection: close" in head.lower()
+        assert closed  # leftover body bytes can't poison a next request
+        # Content-Length over the cap: 400 before any body byte is read.
+        raw = (
+            b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 999999999\r\n\r\n"
+        )
+        head, closed = _raw_http_exchange(g.gw.port, raw)
+        assert head.startswith(b"HTTP/1.1 400")
+        assert b"connection: close" in head.lower()
+        assert closed
+        # The server itself is still healthy.
+        status, _ = _post(g.base, {"prompt": [1, 2], "max_new_tokens": 4})
+        assert status == 200
+
+
+def test_gateway_full_response_disconnect_counts_499(params):
+    """Non-streaming path: a client that RSTs while the handler is blocked
+    on the result must not kill the handler thread with a traceback — the
+    failed write is caught and the response accounted as a 499."""
+    gobj = _Gateway(params)
+    _throttle(gobj.eng)
+    with gobj as g:
+        body = json.dumps({"prompt": [7, 7], "max_new_tokens": 24}).encode()
+        s = socket.create_connection(("127.0.0.1", g.gw.port), timeout=60)
+        s.sendall(
+            b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        time.sleep(0.2)  # let the handler block on result()
+        # RST on close so the server's eventual write fails immediately.
+        s.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+        s.close()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if g.gw.http_counters.get("http_responses_499", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert g.gw.http_counters.get("http_responses_499", 0) == 1
+        assert g.gw.http_counters.get("http_responses_200", 0) == 0
+        # The server survives to serve the next client.
+        status, _ = _post(g.base, {"prompt": [1, 2], "max_new_tokens": 4})
+        assert status == 200
+    assert g.eng.alloc.available == 24 - 1
 
 
 def test_gateway_backpressure_429(params):
