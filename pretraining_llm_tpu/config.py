@@ -787,10 +787,31 @@ class FrontendConfig:
     default_deadline_s: float = 0.0
     # How long the idle engine-loop thread sleeps between inbox polls.
     idle_wait_s: float = 0.005
+    # Per-request tracing: head-sampling fraction for requests without an
+    # inbound ``traceparent`` (whose own sampled flag is honored). 0 =
+    # tracing off — the default, and the zero-cost path.
+    trace_sample: float = 0.0
+    # Chrome-trace JSON export path, written at gateway shutdown when
+    # tracing is on ("" = no export).
+    trace_path: str = ""
+    # /healthz returns 503 once the engine loop has gone this many
+    # seconds without completing a scheduler turn. 0 disables — the
+    # default, because a cold-start jit compile legitimately holds the
+    # loop thread for minutes on slow hosts.
+    healthz_stale_after_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not 0 <= self.port <= 65535:
             raise ValueError(f"port must be in [0, 65535], got {self.port}")
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {self.trace_sample}"
+            )
+        if self.healthz_stale_after_s < 0:
+            raise ValueError(
+                f"healthz_stale_after_s must be >= 0, got "
+                f"{self.healthz_stale_after_s}"
+            )
         if self.max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {self.max_queue_depth}"

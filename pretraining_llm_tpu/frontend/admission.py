@@ -67,6 +67,7 @@ class AdmissionController:
         retry_after_s: float = 1.0,
         shed_infeasible: bool = True,
         tpot_ewma_alpha: float = 0.2,
+        registry: Optional[object] = None,
     ) -> None:
         if max_queue_depth < 1:
             raise ValueError(
@@ -93,6 +94,19 @@ class AdmissionController:
         self.stats: Dict[str, int] = {
             "admitted": 0, "rejected_busy": 0, "rejected_infeasible": 0,
         }
+        # Typed live counters (observability.metrics.MetricsRegistry):
+        # the same tallies as `stats`, but as real Prometheus counters
+        # with the rejection reason as a label. None = untyped only.
+        self._c_admitted = self._c_rejected = None
+        if registry is not None:
+            self._c_admitted = registry.counter(
+                "admission_admitted_total", "requests admitted")
+            self._c_rejected = {
+                reason: registry.counter(
+                    "admission_rejected_total",
+                    "requests rejected at admission", reason=reason)
+                for reason in ("busy", "infeasible")
+            }
 
     # -- queries ------------------------------------------------------------
 
@@ -132,38 +146,49 @@ class AdmissionController:
             if deadline_s <= 0:
                 with self._lock:
                     self.stats["rejected_infeasible"] += 1
+                if self._c_rejected is not None:
+                    self._c_rejected["infeasible"].inc()
                 raise RejectedInfeasible("deadline already expired", 0.0)
             est = self.estimate_service_s(max_new_tokens)
             if est is not None and est > deadline_s:
                 with self._lock:
                     self.stats["rejected_infeasible"] += 1
+                if self._c_rejected is not None:
+                    self._c_rejected["infeasible"].inc()
                 raise RejectedInfeasible(
                     f"needs ~{est:.3f}s of decode but only {deadline_s:.3f}s "
                     f"remain before the deadline",
                     est,
                 )
-        with self._lock:
-            if self._live >= self.max_queue_depth:
-                self.stats["rejected_busy"] += 1
-                raise RejectedBusy(
-                    f"{self._live} requests in flight (limit "
-                    f"{self.max_queue_depth})",
-                    self.retry_after_s,
-                )
-            if (
-                self.max_outstanding_tokens
-                and self._outstanding_tokens + cost > self.max_outstanding_tokens
-            ):
-                self.stats["rejected_busy"] += 1
-                raise RejectedBusy(
-                    f"outstanding-token budget exhausted "
-                    f"({self._outstanding_tokens} + {cost} > "
-                    f"{self.max_outstanding_tokens})",
-                    self.retry_after_s,
-                )
-            self._live += 1
-            self._outstanding_tokens += cost
-            self.stats["admitted"] += 1
+        try:
+            with self._lock:
+                if self._live >= self.max_queue_depth:
+                    self.stats["rejected_busy"] += 1
+                    raise RejectedBusy(
+                        f"{self._live} requests in flight (limit "
+                        f"{self.max_queue_depth})",
+                        self.retry_after_s,
+                    )
+                if (
+                    self.max_outstanding_tokens
+                    and self._outstanding_tokens + cost > self.max_outstanding_tokens
+                ):
+                    self.stats["rejected_busy"] += 1
+                    raise RejectedBusy(
+                        f"outstanding-token budget exhausted "
+                        f"({self._outstanding_tokens} + {cost} > "
+                        f"{self.max_outstanding_tokens})",
+                        self.retry_after_s,
+                    )
+                self._live += 1
+                self._outstanding_tokens += cost
+                self.stats["admitted"] += 1
+        except RejectedBusy:
+            if self._c_rejected is not None:
+                self._c_rejected["busy"].inc()
+            raise
+        if self._c_admitted is not None:
+            self._c_admitted.inc()
         return Ticket(cost_tokens=cost)
 
     def release(self, ticket: Ticket, *, tpot_s: Optional[float] = None) -> None:

@@ -31,9 +31,18 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from pretraining_llm_tpu.frontend.admission import AdmissionController, Ticket
+from pretraining_llm_tpu.frontend.admission import (
+    AdmissionController,
+    RejectedBusy,
+    RejectedInfeasible,
+    Ticket,
+)
 
 TERMINAL_STATUSES = ("done", "cancelled", "expired", "error")
+
+# Distinguishes "caller made no tracing decision" (loop samples from its
+# own tracer) from an explicit trace=None (gateway decided: unsampled).
+_TRACE_UNSET = object()
 
 
 @dataclasses.dataclass
@@ -49,6 +58,7 @@ class FrontendRequest:
     deadline: Optional[float]  # monotonic deadline, None = none
     submitted_s: float
     ticket: Optional[Ticket] = None
+    trace: Any = None  # observability.tracing.RequestTrace | None
     out_q: "queue.Queue[Tuple]" = dataclasses.field(default_factory=queue.Queue)
     rid: Optional[int] = None
     status: str = "queued"
@@ -97,6 +107,8 @@ class EngineLoop:
         bus: Any = None,
         idle_wait_s: float = 0.005,
         clock: Any = time.monotonic,
+        tracer: Any = None,
+        registry: Any = None,
     ) -> None:
         self.engine = engine
         self.admission = admission
@@ -105,8 +117,48 @@ class EngineLoop:
         # Deadlines compare against this clock; injectable so tests can
         # expire a request mid-flight deterministically.
         self._clock = clock
+        # Per-request tracing (observability.tracing.Tracer). None = off:
+        # submit() mints no trace and every recording site is a single
+        # attribute/None check.
+        self.tracer = tracer
+        # Typed live metrics (observability.metrics.MetricsRegistry).
+        # Histograms are observed once per terminal / reaped window, the
+        # token counter once per committed token — each is one lock +
+        # bisect, no device work anywhere.
+        self.registry = registry
+        self._h_ttft = self._h_tpot = self._h_queue = self._h_e2e = None
+        self._c_terminal: Dict[str, Any] = {}
+        self._c_tokens = self._c_submitted = None
+        if registry is not None:
+            self._h_ttft = registry.histogram(
+                "ttft_seconds", "submit -> first committed token")
+            self._h_tpot = registry.histogram(
+                "tpot_seconds", "per-output-token seconds after the first")
+            self._h_queue = registry.histogram(
+                "queue_wait_seconds", "submit -> engine row claim")
+            self._h_e2e = registry.histogram(
+                "e2e_seconds", "submit -> terminal")
+            self._c_terminal = {
+                s: registry.counter(
+                    "requests_terminal_total",
+                    "requests reaching a terminal status", status=s)
+                for s in TERMINAL_STATUSES
+            }
+            self._c_tokens = registry.counter(
+                "tokens_streamed_total", "committed tokens streamed to clients")
+            self._c_submitted = registry.counter(
+                "requests_submitted_total", "requests accepted past admission")
+            engine.window_hist = registry.histogram(
+                "window_seconds", "decode-window dispatch -> reap wall time")
+            engine.host_blocked_hist = registry.histogram(
+                "host_blocked_seconds", "host blocked on window readback")
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
+        # Engine-loop liveness: monotonic time of the last completed
+        # scheduler turn; /healthz subtracts it from now to distinguish a
+        # wedged loop (stuck in one turn) from a healthy idle one (which
+        # keeps turning).
+        self._last_turn = self._clock()
         self._inbox: "queue.Queue[FrontendRequest]" = queue.Queue()
         # Guards the submit-side put against the shutdown drain: once the
         # loop thread has drained the inbox (_drained), a late put would
@@ -155,37 +207,73 @@ class EngineLoop:
         max_new_tokens: int,
         *,
         deadline_s: Optional[float] = None,
+        trace: Any = _TRACE_UNSET,
     ) -> FrontendRequest:
         """Validate, pass admission, enqueue. Raises ``ValueError`` on a
         malformed request (gateway: 400), ``RejectedBusy`` (429) or
         ``RejectedInfeasible`` (504) from the admission controller.
         Returns immediately with the request handle; tokens stream on its
-        ``out_q``."""
+        ``out_q``.
+
+        ``trace`` is a gateway-minted RequestTrace (the gateway owns the
+        inbound ``traceparent`` header and the sampling decision — an
+        explicit ``None`` means "decided: unsampled" and the loop must
+        NOT re-sample); with no gateway in the path (in-process loadgen)
+        the argument is left unset and the loop mints one from its own
+        tracer. A rejected request still gets a complete one-span trace:
+        admission outcome + a ``rejected`` terminal."""
         if self._stop.is_set() or self._thread is None:
             raise RuntimeError("EngineLoop is not running")
-        # validate_request reads only construction-time constants — safe
-        # from gateway threads while the loop thread drives the engine.
-        max_new = self.engine.validate_request(prompt, max_new_tokens)
-        ticket = None
-        if self.admission is not None:
-            ticket = self.admission.try_admit(
-                len(prompt), max_new, deadline_s=deadline_s
+        if trace is _TRACE_UNSET:
+            trace = (
+                self.tracer.begin_request() if self.tracer is not None else None
             )
+        trace_fields = (
+            {"trace_id": trace.trace_id} if trace is not None else {}
+        )
+        try:
+            # validate_request reads only construction-time constants —
+            # safe from gateway threads while the loop thread runs.
+            max_new = self.engine.validate_request(prompt, max_new_tokens)
+        except ValueError as e:
+            self._rejected(trace, "invalid", str(e), trace_fields)
+            raise
+        ticket = None
+        t_adm = time.perf_counter()
+        if self.admission is not None:
+            try:
+                ticket = self.admission.try_admit(
+                    len(prompt), max_new, deadline_s=deadline_s
+                )
+            except RejectedBusy as e:
+                self._rejected(trace, "busy", e.reason, trace_fields)
+                raise
+            except RejectedInfeasible as e:
+                self._rejected(trace, "infeasible", e.reason, trace_fields)
+                raise
         try:
             now = self._clock()
+            if trace is not None:
+                trace.span("req.admission", t_adm, outcome="admitted")
+                # The engine's queue span starts here: admission passed,
+                # the request is now waiting (inbox + engine queue).
+                trace.marks["submit"] = time.perf_counter()
             req = FrontendRequest(
                 prompt=[int(t) for t in prompt],
                 max_new=max_new,
                 deadline=(now + deadline_s) if deadline_s is not None else None,
                 submitted_s=now,
                 ticket=ticket,
+                trace=trace,
             )
             with self._lock:
                 self.counters["submitted"] += 1
+            if self._c_submitted is not None:
+                self._c_submitted.inc()
             if self.bus is not None:
                 self.bus.emit(
                     "req_submit", n_prompt=len(req.prompt), max_new=max_new,
-                    deadline_s=deadline_s,
+                    deadline_s=deadline_s, **trace_fields,
                 )
             with self._inbox_lock:
                 if self._drained:
@@ -197,9 +285,31 @@ class EngineLoop:
             # the queue-depth slot leaks until restart.
             if ticket is not None:
                 self.admission.release(ticket)
+            if trace is not None:
+                trace.finish("error", reason="submit failed")
             raise
         self._wake.set()
         return req
+
+    def _rejected(
+        self,
+        trace: Any,
+        reason: str,
+        detail: str,
+        trace_fields: Dict[str, Any],
+    ) -> None:
+        """Bookkeeping for a request refused before the inbox: one
+        ``req_rejected`` event and a finished (rejected) trace."""
+        if self.bus is not None:
+            self.bus.emit(
+                "req_rejected", reason=reason, detail=detail, **trace_fields
+            )
+        if trace is not None:
+            trace.span(
+                "req.admission", time.perf_counter(),
+                outcome="rejected", reason=reason,
+            )
+            trace.finish("rejected", reason=reason)
 
     def cancel(self, req: FrontendRequest) -> None:
         """Request cancellation (client disconnect / explicit abort). The
@@ -207,6 +317,13 @@ class EngineLoop:
         stay delivered, then the handle gets a ``cancelled`` terminal."""
         req.cancel_requested = True
         self._wake.set()
+
+    def last_turn_age_s(self) -> float:
+        """Seconds since the loop thread last COMPLETED a scheduler turn
+        — the /healthz liveness signal. A healthy loop (busy or idle)
+        keeps this near zero; a loop wedged inside one turn (a hung
+        device dispatch) lets it grow without bound."""
+        return max(0.0, self._clock() - self._last_turn)
 
     def metrics(self) -> Dict[str, float]:
         """Counter snapshot for /metrics: loop counters + live gauges +
@@ -240,6 +357,7 @@ class EngineLoop:
                     # A long window may have carried requests past their
                     # deadlines; apply before the next dispatch extends them.
                     self._apply_cancels_and_deadlines()
+                self._last_turn = self._clock()
                 if not busy and self._inbox.empty() and not self._stop.is_set():
                     self._wake.wait(self.idle_wait_s)
         except BaseException as e:
@@ -297,6 +415,8 @@ class EngineLoop:
             except ValueError as e:  # pre-validated; belt and suspenders
                 self._terminal(req, "error", reason=str(e))
                 continue
+            if req.trace is not None:
+                eng.set_trace(req.rid, req.trace)
             req.status = "active"
             self._by_rid[req.rid] = req
 
@@ -328,6 +448,8 @@ class EngineLoop:
         req.tokens.append(tok)
         with self._lock:
             self.counters["tokens_streamed"] += 1
+        if self._c_tokens is not None:
+            self._c_tokens.inc()
         req.out_q.put(("token", tok))
 
     def _on_finish(self, rid: int, out: List[int]) -> None:
@@ -358,8 +480,11 @@ class EngineLoop:
             eng.req_timing.pop(req.rid, None)
             eng.finished.pop(req.rid, None)
             eng.cancelled.discard(req.rid)
+            eng.pop_trace(req.rid)
         info.update(timing)
         info["n_tokens"] = len(req.tokens)
+        if req.trace is not None:
+            info["trace_id"] = req.trace.trace_id
         req.info = info
         tpot = None
         if (
@@ -374,6 +499,32 @@ class EngineLoop:
             self.admission.release(req.ticket, tpot_s=tpot)
         with self._lock:
             self.counters[self._COUNTER_FOR[status]] += 1
+        if self.registry is not None:
+            # e2e is observed for EVERY terminal (engine timing when the
+            # request ran, loop clock otherwise) so the histogram _count
+            # equals the terminal-event count by construction; the other
+            # latencies only exist for phases the request reached.
+            self._h_e2e.observe(
+                timing.get("e2e_s", self._clock() - req.submitted_s))
+            if "queue_wait_s" in timing:
+                self._h_queue.observe(timing["queue_wait_s"])
+            if "ttft_s" in timing:
+                self._h_ttft.observe(timing["ttft_s"])
+            if tpot is not None:
+                self._h_tpot.observe(tpot)
+            self._c_terminal[status].inc()
+        if req.trace is not None and not req.trace.finished:
+            if "admit" not in req.trace.marks:
+                # Never admitted (cancelled/expired in the inbox or the
+                # engine's waiting queue): close the queue span here so
+                # the tree is still complete — queue time IS where this
+                # request's whole life went.
+                req.trace.span(
+                    "req.queue",
+                    req.trace.marks.get("submit", req.trace.t0),
+                    outcome=status,
+                )
+            req.trace.finish(status, n_tokens=len(req.tokens))
         if self.bus is not None:
             self.bus.emit(f"req_{status}", **info)
         req.out_q.put(("end", status, info))

@@ -9,11 +9,17 @@ device work. Endpoints:
                       streaming when ``"stream": true`` (one
                       ``data: {...}`` event per committed token, then a
                       terminal ``data: {"done": ...}`` and
-                      ``data: [DONE]``);
-  GET  /healthz       liveness + queue gauges;
-  GET  /metrics       Prometheus text exposition (the observability
-                      exporter's renderer) of loop/engine/admission/HTTP
-                      counters.
+                      ``data: [DONE]``); an inbound W3C ``traceparent``
+                      header joins the caller's trace (when the loop has
+                      a tracer), and terminal bodies carry ``trace_id``;
+  GET  /healthz       liveness + queue gauges + engine-loop staleness
+                      (seconds since the last scheduler turn; 503 past
+                      ``healthz_stale_after_s`` — a wedged loop must not
+                      look like a healthy idle process);
+  GET  /metrics       Prometheus text exposition: the loop's typed
+                      registry (counters/histograms) when wired, plus
+                      loop/engine/admission gauges and typed HTTP
+                      counters (``..._total``).
 
 Request schema (unknown keys are a 400 — a typo'd knob must not be
 silently ignored):
@@ -68,11 +74,21 @@ class ServingGateway:
         encode: Optional[Callable[[str], Any]] = None,
         decode: Optional[Callable[[Any], str]] = None,
         default_deadline_s: float = 0.0,
+        healthz_stale_after_s: float = 0.0,
     ) -> None:
+        if healthz_stale_after_s < 0:
+            raise ValueError(
+                f"healthz_stale_after_s must be >= 0 (0 = disabled), got "
+                f"{healthz_stale_after_s}"
+            )
         self.loop = loop
         self.encode = encode
         self.decode = decode
         self.default_deadline_s = float(default_deadline_s)
+        # 0 disables the staleness 503: a cold-start jit compile can
+        # legitimately hold the loop thread for minutes, so the threshold
+        # is opt-in and deployment-tuned.
+        self.healthz_stale_after_s = float(healthz_stale_after_s)
         self._counters_lock = threading.Lock()
         self.http_counters: Dict[str, int] = {}
         gateway = self
@@ -118,11 +134,39 @@ class ServingGateway:
                 self.http_counters.get("http_requests_total", 0) + 1
             )
 
+    def _http_counter_lines(self) -> str:
+        """The HTTP tallies as VALID Prometheus counters: one
+        ``http_requests_total`` plus ``http_responses_total{code=...}``
+        children (the per-code dict keys become a label, which is what
+        they always were)."""
+        with self._counters_lock:
+            http = dict(self.http_counters)
+        lines = [
+            "# TYPE pllm_serving_http_requests_total counter",
+            "pllm_serving_http_requests_total "
+            f"{float(http.get('http_requests_total', 0))}",
+            "# TYPE pllm_serving_http_responses_total counter",
+        ]
+        for key in sorted(http):
+            if key.startswith("http_responses_"):
+                code = key.rsplit("_", 1)[1]
+                lines.append(
+                    f'pllm_serving_http_responses_total{{code="{code}"}} '
+                    f"{float(http[key])}"
+                )
+        return "\n".join(lines) + "\n"
+
     def metrics_text(self) -> str:
         merged: Dict[str, float] = dict(self.loop.metrics())
-        with self._counters_lock:
-            merged.update(self.http_counters)
-        return prometheus_lines(merged, prefix="pllm_serving_")
+        registry = getattr(self.loop, "registry", None)
+        if registry is not None:
+            # Typed series (counters + latency histograms) first, then the
+            # legacy loop/engine/admission snapshot as gauges, then the
+            # HTTP counters — one exposition, lint-clean.
+            body = registry.render(extra_gauges=merged)
+        else:
+            body = prometheus_lines(merged, prefix="pllm_serving_")
+        return body + self._http_counter_lines()
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):
@@ -170,11 +214,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
         if self.path == "/healthz":
-            m = self.gateway.loop.metrics()
-            self._send_json(200, {
-                "status": "ok",
+            gw = self.gateway
+            m = gw.loop.metrics()
+            age = gw.loop.last_turn_age_s()
+            stale = (
+                gw.healthz_stale_after_s > 0
+                and age > gw.healthz_stale_after_s
+            )
+            self._send_json(503 if stale else 200, {
+                "status": "stale" if stale else "ok",
                 "active_requests": m.get("active_requests", 0),
                 "completed": m.get("completed", 0),
+                "engine_loop_last_turn_age_s": round(age, 3),
             })
         elif self.path == "/metrics":
             body = self.gateway.metrics_text().encode()
@@ -207,22 +258,35 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send_json(400, {"error": str(e)})
             return
+        trace = None
+        tracer = getattr(gw.loop, "tracer", None)
+        if tracer is not None:
+            # Gateway accept is where the trace is minted: an inbound W3C
+            # traceparent joins the caller's trace (its sampling decision
+            # honored), otherwise head-sampling applies.
+            trace = tracer.begin_request(self.headers.get("traceparent"))
+        err_fields = (
+            {"trace_id": trace.trace_id} if trace is not None else {}
+        )
         try:
-            req = gw.loop.submit(prompt, max_new, deadline_s=deadline_s)
+            req = gw.loop.submit(
+                prompt, max_new, deadline_s=deadline_s, trace=trace
+            )
         except ValueError as e:
             # The engine's submit-time validation: the 4xx that replaces a
             # downstream shape error.
-            self._send_json(400, {"error": str(e)})
+            self._send_json(400, {"error": str(e), **err_fields})
             return
         except RejectedBusy as e:
             self._send_json(
-                429, {"error": f"overloaded: {e.reason}"},
+                429, {"error": f"overloaded: {e.reason}", **err_fields},
                 Retry_After=f"{max(1, round(e.retry_after_s))}",
             )
             return
         except RejectedInfeasible as e:
             self._send_json(
-                504, {"error": f"deadline cannot be met: {e.reason}"}
+                504,
+                {"error": f"deadline cannot be met: {e.reason}", **err_fields},
             )
             return
         except RuntimeError as e:

@@ -60,6 +60,12 @@ class LoadSpec:
     slo_ttft_s: float = 0.0
     slo_e2e_s: float = 0.0
     seed: int = 0
+    # HTTP client only: send a seeded W3C ``traceparent`` header per
+    # request (sampled flag set), so the gateway joins trace ids the
+    # workload chose — outcomes then correlate with the server's trace
+    # export byte-for-byte. The in-process client instead reads back the
+    # ids the loop's tracer minted.
+    send_traceparent: bool = False
 
     def __post_init__(self) -> None:
         if self.mode not in ("open", "closed"):
@@ -120,6 +126,16 @@ class RequestOutcome:
     ttft_s: Optional[float] = None
     tpot_s: Optional[float] = None
     e2e_s: Optional[float] = None
+    trace_id: Optional[str] = None
+
+
+def traceparent_for(spec: LoadSpec, index: int) -> str:
+    """Deterministic per-request W3C traceparent (sampled): same spec ->
+    same trace ids, so a rerun's trace export is join-comparable."""
+    rng = random.Random((spec.seed << 20) ^ index)
+    trace_id = f"{rng.getrandbits(128) or 1:032x}"
+    span_id = f"{rng.getrandbits(64) or 1:016x}"
+    return f"00-{trace_id}-{span_id}-01"
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -261,6 +277,7 @@ def run_engine_loop(loop: Any, spec: LoadSpec) -> LoadReport:
             ttft_s=info.get("ttft_s"),
             tpot_s=info.get("tpot_s"),
             e2e_s=info.get("e2e_s", time.monotonic() - t0),
+            trace_id=info.get("trace_id"),
         )
 
     return _execute(spec, client)
@@ -278,11 +295,15 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
         if spec.deadline_s is not None:
             payload["deadline_s"] = spec.deadline_s
         data = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        trace_id = None
+        if spec.send_traceparent:
+            tp = traceparent_for(spec, sr.index)
+            headers["traceparent"] = tp
+            trace_id = tp.split("-")[1]
         t0 = time.monotonic()
         try:
-            http_req = urllib.request.Request(
-                url, data=data, headers={"Content-Type": "application/json"}
-            )
+            http_req = urllib.request.Request(url, data=data, headers=headers)
             with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
                 body = json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
@@ -304,9 +325,10 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
                 ttft_s=body.get("ttft_s"),
                 tpot_s=body.get("tpot_s"),
                 e2e_s=body.get("e2e_s"),
+                trace_id=body.get("trace_id", trace_id),
             )
         except (urllib.error.URLError, OSError, ValueError):
-            return RequestOutcome(sr.index, "error")
+            return RequestOutcome(sr.index, "error", trace_id=trace_id)
         return RequestOutcome(
             sr.index,
             body.get("status", "done"),
@@ -314,6 +336,7 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
             ttft_s=body.get("ttft_s"),
             tpot_s=body.get("tpot_s"),
             e2e_s=body.get("e2e_s", time.monotonic() - t0),
+            trace_id=body.get("trace_id", trace_id),
         )
 
     return _execute(spec, client)

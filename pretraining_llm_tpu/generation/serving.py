@@ -96,6 +96,7 @@ class _Window:
     emit: Any = None                # spec: (B, k+1) device emissions
     n_emit: Any = None              # spec: (B,) device per-row emit counts
     seq_dev: Any = None             # spec: (B,) device frontier at dispatch
+    t_dispatch: float = 0.0         # perf_counter at dispatch (trace spans)
 
 
 class ServingEngine:
@@ -293,6 +294,19 @@ class ServingEngine:
         # batch mode.
         self.on_token: Optional[Callable[[int, int], None]] = None
         self.on_finish: Optional[Callable[[int, List[int]], None]] = None
+        # Per-request traces (observability.tracing.RequestTrace), keyed
+        # by rid — installed by the frontend via set_trace(). Empty when
+        # tracing is off, and every recording site below guards on that
+        # emptiness first, so the untraced hot path pays one dict truth
+        # test. Recording itself is perf_counter reads + a list append:
+        # no device syncs on any path.
+        self.traces: Dict[int, Any] = {}
+        # Optional latency histograms (observability.metrics.Histogram),
+        # installed by the frontend: per-window wall duration and per-
+        # window host-blocked readback seconds. Observed once per reaped
+        # window — never per token.
+        self.window_hist: Optional[Any] = None
+        self.host_blocked_hist: Optional[Any] = None
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
@@ -376,6 +390,18 @@ class ServingEngine:
         self.req_timing[rid] = {"submit_s": self._now()}
         self.waiting.append(_Request(rid, [int(t) for t in prompt_ids], max_new))
         return rid
+
+    def set_trace(self, rid: int, trace: Any) -> None:
+        """Attach a RequestTrace to a submitted request; the scheduler
+        records queue/prefill/window spans into it. ``None`` is a no-op
+        (the unsampled case), so callers need no guard."""
+        if trace is not None:
+            self.traces[rid] = trace
+
+    def pop_trace(self, rid: int) -> Any:
+        """Detach (and return) a request's trace at terminal time; the
+        caller owns finishing it."""
+        return self.traces.pop(rid, None)
 
     def cancel(self, rid: int) -> bool:
         """Abort a live request, releasing its row and pool blocks
@@ -680,7 +706,8 @@ class ServingEngine:
         for i in active:
             self.seq_lens[i] = min(int(self.seq_lens[i]) + n, capacity)
         self._inflight.append(
-            _Window(kind="decode", snapshot=snapshot, n=n, toks=toks)
+            _Window(kind="decode", snapshot=snapshot, n=n, toks=toks,
+                    t_dispatch=time.perf_counter())
         )
 
     def _dispatch_spec_round(self) -> None:
@@ -723,7 +750,8 @@ class ServingEngine:
         snapshot = [(i, self.rows[i]) for i in active]
         self._inflight.append(
             _Window(kind="spec", snapshot=snapshot, n=k + 1,
-                    emit=emit, n_emit=n_emit, seq_dev=seq_dev)
+                    emit=emit, n_emit=n_emit, seq_dev=seq_dev,
+                    t_dispatch=time.perf_counter())
         )
 
     def _merge_admitted(self, base, seq_dev=None):
@@ -749,8 +777,8 @@ class ServingEngine:
         exactly as in the synchronous path). The readback wait is the
         host-blocked time deep pipelining exists to hide — measured per
         window into stats and the span's trace args."""
-        with _spans.span("serving.reap_window",
-                         window=self.stats["windows_reaped"]) as meta:
+        widx = self.stats["windows_reaped"]
+        with _spans.span("serving.reap_window", window=widx) as meta:
             t0 = time.perf_counter()
             with _spans.span("serving.host_blocked"):
                 if w.kind == "spec":
@@ -758,10 +786,15 @@ class ServingEngine:
                     n_emit = np.asarray(w.n_emit)  # (B,)
                 else:
                     window = np.asarray(w.toks)    # (B, n) — THE sync point
-            blocked = time.perf_counter() - t0
+            t_reaped = time.perf_counter()
+            blocked = t_reaped - t0
             meta["host_blocked_s"] = round(blocked, 6)
             self.stats["host_blocked_s"] += blocked
             self.stats["windows_reaped"] += 1
+            if self.window_hist is not None and w.t_dispatch:
+                self.window_hist.observe(t_reaped - w.t_dispatch)
+            if self.host_blocked_hist is not None:
+                self.host_blocked_hist.observe(blocked)
             capacity = self.max_blocks * self.block_size
             for row, req in w.snapshot:
                 if req.row != row or self.rows[row] is not req:
@@ -770,6 +803,22 @@ class ServingEngine:
                     # are surplus garbage by the lag contract. (Preemption
                     # can't land here: it flushes the queue first.)
                     continue
+                if self.traces:
+                    tr = self.traces.get(req.rid)
+                    if tr is not None and not tr.finished:
+                        # One span per (request, window) it rode: dispatch
+                        # -> reap. Under deep pipelining these intervals
+                        # OVERLAP across windows; the SLO decomposition
+                        # unions them into decode time. host_blocked_s is
+                        # the whole window's readback wait — per request
+                        # it reads as "this much of my window was the
+                        # host, not the device".
+                        tr.span(
+                            "req.window",
+                            w.t_dispatch or t0, t_reaped,
+                            kind=w.kind, steps=w.n, window=widx,
+                            host_blocked_s=round(blocked, 6),
+                        )
                 self._resolve_first(req)
                 if req.row is None:  # first token alone finished it
                     continue
@@ -825,7 +874,15 @@ class ServingEngine:
         incarnation, re-decoded ones arrive as prompt, not output)."""
         t = self.req_timing.get(req.rid)
         if t is not None and tok != self.stop_token:
-            t.setdefault("first_token_s", self._now())
+            if "first_token_s" not in t:
+                t["first_token_s"] = self._now()
+                if self.traces:
+                    tr = self.traces.get(req.rid)
+                    if tr is not None:
+                        # Zero-duration point on the waterfall; the TTFT
+                        # histogram is observed at terminal time from
+                        # req_timing, never here (per-token hot path).
+                        tr.event("req.first_token")
         if self.on_token is not None and tok != self.stop_token:
             self.on_token(req.rid, tok)
 
@@ -938,6 +995,17 @@ class ServingEngine:
                 # setdefault: a preempted request's re-admission must not
                 # move its queue-wait mark.
                 t.setdefault("admit_s", self._now())
+            if self.traces:
+                tr = self.traces.get(req.rid)
+                if tr is not None and "admit" not in tr.marks:
+                    # Same setdefault rule: the queue span is submit ->
+                    # FIRST row claim; preemption re-admissions keep it.
+                    now_p = time.perf_counter()
+                    tr.span(
+                        "req.queue", tr.marks.get("submit", tr.t0), now_p,
+                        n_prompt=p,
+                    )
+                    tr.marks["admit"] = now_p
             self.rows[row] = req  # claim now: n_active sees earlier admits
             self.tables[row, :] = 0
             self.tables[row, : len(blocks)] = blocks
@@ -951,11 +1019,25 @@ class ServingEngine:
             r.blocks[: paged.required_blocks(len(r.prompt), self.block_size)]
             for r in admits
         ]
+        t_prefill = time.perf_counter()
         toks_dev, self.pools = paged.prefill_into_pool_batched(
             self.params, self.cfg, self.pools, prompts, prefill_ids,
             sub, temperature=self.temperature, top_k=self.top_k,
             top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
         )
+        if self.traces:
+            # Host-side prefill span (dispatch + any compile; the async
+            # device compute itself overlaps the next windows). Batched
+            # admissions share one interval — the per-request cost of a
+            # shared program IS the shared wall time.
+            t_prefill_end = time.perf_counter()
+            for req in admits:
+                tr = self.traces.get(req.rid)
+                if tr is not None:
+                    tr.span(
+                        "req.prefill", t_prefill, t_prefill_end,
+                        n_prompt=len(req.prompt), batch=len(admits),
+                    )
         if self.spec_k:
             # The draft cache must cover the same pages (its sampled
             # tokens are discarded — the target's first token above is

@@ -37,7 +37,26 @@ device→host syncs (tested). The hub below is what the trainer wires in.
 from pretraining_llm_tpu.observability.events import EVENT_KINDS, EventBus, sanitize_record
 from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
 from pretraining_llm_tpu.observability.spans import SpanRecorder, get_recorder, span
-from pretraining_llm_tpu.observability.export import prometheus_lines, write_textfile
+from pretraining_llm_tpu.observability.export import (
+    lint_exposition,
+    prometheus_lines,
+    write_textfile,
+)
+from pretraining_llm_tpu.observability.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+)
+from pretraining_llm_tpu.observability.tracing import (
+    RequestTrace,
+    SpanContext,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
 from pretraining_llm_tpu.observability.device import CompileWatcher, DeviceTelemetry
 from pretraining_llm_tpu.observability.hub import ObservabilityHub
 
@@ -50,8 +69,20 @@ __all__ = [
     "SpanRecorder",
     "get_recorder",
     "span",
+    "lint_exposition",
     "prometheus_lines",
     "write_textfile",
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "log_buckets",
+    "RequestTrace",
+    "SpanContext",
+    "Tracer",
+    "format_traceparent",
+    "parse_traceparent",
     "CompileWatcher",
     "DeviceTelemetry",
     "ObservabilityHub",

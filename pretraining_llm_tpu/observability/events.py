@@ -47,9 +47,12 @@ EVENT_KINDS = (
     "device_memory",  # HBM sample: per-device bytes_in_use/peak
     "fault_injected", # drill fault fired: kind, step
     # Serving-frontend request lifecycle (frontend/engine_loop.py). The
-    # terminal kinds carry queue_wait_s/ttft_s/e2e_s + n_tokens, so the
-    # event stream doubles as the per-request serving audit log.
+    # terminal kinds carry queue_wait_s/ttft_s/e2e_s + n_tokens, and every
+    # req_* record carries trace_id when the request is traced, so the
+    # event stream doubles as the per-request serving audit log and joins
+    # against the Chrome-trace span tree in obs_report --slo.
     "req_submit",     # accepted past validation+admission: n_prompt, max_new
+    "req_rejected",   # refused at admission: reason=busy|infeasible|invalid
     "req_done",       # generated to completion (HTTP 200)
     "req_cancelled",  # client cancelled / disconnected (HTTP 499)
     "req_expired",    # deadline passed mid-flight (HTTP 504)

@@ -28,6 +28,7 @@ from pretraining_llm_tpu.observability.device import CompileWatcher, DeviceTelem
 from pretraining_llm_tpu.observability.events import EventBus
 from pretraining_llm_tpu.observability.export import write_textfile
 from pretraining_llm_tpu.observability.goodput import GoodputAccountant
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
 from pretraining_llm_tpu.observability.spans import SpanRecorder
 
 
@@ -47,6 +48,16 @@ class ObservabilityHub:
             CompileWatcher(self.bus) if cfg.compile_telemetry else None
         )
         self._boundaries = 0
+        # Typed registry behind the textfile export: the flat per-boundary
+        # metrics still ride along as gauges, but the step-window latency
+        # becomes a real histogram and the span-recorder drop count a real
+        # counter — same module the serving gateway's /metrics uses.
+        self.registry = MetricsRegistry(prefix="pllm_")
+        self._h_window = self.registry.histogram(
+            "step_window_seconds", "wall seconds per log window")
+        self._c_dropped = self.registry.counter(
+            "spans_dropped_total", "span-recorder events lost to saturation")
+        self._dropped_seen = 0
 
     # -- run lifecycle -------------------------------------------------
 
@@ -88,6 +99,7 @@ class ObservabilityHub:
                 self.spans.export(self.cfg.spans_path)
             except OSError:
                 pass  # a full disk must not mask the run's own exit path
+        self._sync_dropped()
         self._write_prometheus({"goodput": summary["goodput"]})
         self.bus.close()
         return record
@@ -113,6 +125,8 @@ class ObservabilityHub:
                 steps=int(window.get("window_steps", 0)),
                 dur_s=window["window_s"],
             )
+            self._h_window.observe(window["window_s"])
+        self._sync_dropped()
         interval = self.cfg.device_memory_interval
         if interval > 0 and self._boundaries % interval == 0:
             self.device.sample(step)
@@ -159,10 +173,20 @@ class ObservabilityHub:
 
     # ------------------------------------------------------------------
 
+    def _sync_dropped(self) -> None:
+        """Fold the recorder's drop count into the counter (a counter can
+        only be advanced, so track the delta since last sync)."""
+        dropped = self.spans.dropped
+        if dropped > self._dropped_seen:
+            self._c_dropped.inc(dropped - self._dropped_seen)
+            self._dropped_seen = dropped
+
     def _write_prometheus(self, metrics: Dict[str, Any]) -> None:
         if not (self.is_host0 and self.cfg.prometheus_path):
             return
         try:
-            write_textfile(self.cfg.prometheus_path, metrics)
+            write_textfile(
+                self.cfg.prometheus_path, metrics, registry=self.registry
+            )
         except OSError:
             pass  # metrics export must never take down the run

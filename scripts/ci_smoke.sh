@@ -166,3 +166,86 @@ assert "pllm_serving_http_requests_total" in text, text[:400]
 gw.stop(); loop.stop()
 print(f"gateway smoke ok: {m}")
 EOF
+
+# Tracing gate: the full observability wiring under load. A traced gateway
+# serves a seeded loadgen run (every request carrying a W3C traceparent);
+# /metrics must be lint-clean Prometheus with histogram counts that agree
+# with the terminal-event stream, every response must echo its trace id,
+# and the exported Chrome trace must contain a COMPLETE span tree per
+# request — enforced by obs_report --strict --slo over the same artifacts
+# a production run would ship.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.observability.spans import SpanRecorder
+from pretraining_llm_tpu.observability.tracing import Tracer
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+eng = ServingEngine(params, cfg, max_batch=4, n_blocks=32, block_size=8,
+                    temperature=0.0, steps_per_sched=2, pipeline_depth=2)
+recorder = SpanRecorder()
+bus = EventBus(os.path.join(tmp, "serving_events.jsonl"))
+registry = MetricsRegistry("pllm_serving_")
+loop = EngineLoop(eng, admission=AdmissionController(max_queue_depth=16),
+                  bus=bus, tracer=Tracer(recorder, sample=1.0, seed=11),
+                  registry=registry)
+gw = ServingGateway(loop, port=0, healthz_stale_after_s=30.0)
+loop.start(); gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+spec = LoadSpec(n_requests=8, mode="closed", concurrency=3, seed=5,
+                vocab_size=cfg.vocab_size, max_new_min=4, max_new_max=8,
+                send_traceparent=True)
+report = run_http(base, spec)
+by_status = {}
+for o in report.outcomes:
+    by_status[o.status] = by_status.get(o.status, 0) + 1
+    assert o.trace_id, f"request {o.index} lost its trace id: {o}"
+assert by_status == {"done": 8}, by_status
+
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+count_line = next(
+    l for l in text.splitlines()
+    if l.startswith("pllm_serving_e2e_seconds_count")
+)
+assert float(count_line.split()[-1]) == 8.0, count_line
+
+gw.stop(); loop.stop(); bus.close()
+terminals = 0
+with open(os.path.join(tmp, "serving_events.jsonl")) as f:
+    for line in f:
+        rec = json.loads(line)
+        if rec.get("event") in ("req_done", "req_cancelled",
+                                "req_expired", "req_error"):
+            terminals += 1
+            assert rec.get("trace_id"), rec
+assert terminals == 8, terminals
+assert recorder.dropped == 0, recorder.dropped
+recorder.export(os.path.join(tmp, "serving_trace.json"))
+print(f"tracing smoke ok: {by_status}, {terminals} terminal events")
+EOF
+
+# The offline analyzer must accept the traced run with --strict --slo:
+# every trace tree complete, every SLO-miss attributable, segments
+# summing to e2e. A generous e2e SLO keeps this a structural check, not
+# a performance bet on the CI machine.
+python scripts/obs_report.py --strict --slo --slo_e2e_s 60 \
+    "$OBS_TMP/serving_events.jsonl" --trace "$OBS_TMP/serving_trace.json" \
+    > "$OBS_TMP/slo_report.out"
+grep -q "traces=8 done=8" "$OBS_TMP/slo_report.out" || {
+    echo "obs_report --slo missing the expected 8 traces"; exit 1; }

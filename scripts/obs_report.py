@@ -16,6 +16,16 @@ records carrying ``step_ms`` feed the step-time histogram. ``--strict`` makes
 unparseable lines fatal — a corrupt metrics stream (e.g. bare NaN tokens)
 must fail CI, not be skipped.
 
+``--trace trace.json --slo`` adds the SERVING view: the Chrome-trace export
+(scripts/serve.py --trace, or any SpanRecorder export) is reconstructed into
+per-request span trees keyed by ``trace_id``, each request's end-to-end
+latency is decomposed into queue / admission / prefill / decode /
+host-blocked / other segments that sum exactly to the root span, and every
+SLO miss (``--slo_ttft_s`` / ``--slo_e2e_s``) is attributed to its dominant
+segment — "why we missed", not just "that we missed". Under ``--strict``,
+an incomplete span tree (missing root, terminal, or orphaned children) is
+fatal, which is the CI tracing gate.
+
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
 """
@@ -26,11 +36,15 @@ import argparse
 import json
 import os
 import sys
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
+from pretraining_llm_tpu.observability.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+)
 
 # Events worth a line each in the timeline; step_window/device_memory are
 # high-rate telemetry and only counted.
@@ -132,6 +146,270 @@ def timeline(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+# -- serving trace analysis (--trace / --slo) ------------------------------
+
+# Span names the request tracer emits (tracing.RequestTrace); the segment
+# decomposition below keys on them.
+_ROOT = "req.request"
+_TERMINAL = "req.terminal"
+_SEGMENT_SPANS = ("req.queue", "req.admission", "req.prefill", "req.window")
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Load a Chrome trace-event JSON export (SpanRecorder.to_chrome_trace
+    shape: {"traceEvents": [...], "otherData": {...}})."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise ValueError(f"{path}: not a Chrome trace export (no traceEvents)")
+    return obj
+
+
+def group_request_spans(trace: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:
+    """Group complete ("X") events by ``args.trace_id``. Host spans without
+    a trace_id (the engine loop's own rows) are not request spans and are
+    skipped."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            groups.setdefault(tid, []).append(ev)
+    return groups
+
+
+def _union_s(intervals: List[Tuple[float, float]]) -> float:
+    """Total length (seconds) of the union of [t0, t1] intervals in µs —
+    decode windows OVERLAP under deep pipelining, so summing their
+    durations would double-count device time the request shared."""
+    total = 0.0
+    end = float("-inf")
+    for t0, t1 in sorted(intervals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total / 1e6
+
+
+def check_trace_tree(trace_id: str, spans: List[Dict[str, Any]]) -> List[str]:
+    """Structural completeness for ONE request's span tree; returns
+    problems (empty = complete). What 'complete' means depends on how the
+    request ended: a done request must show the whole journey (queue,
+    prefill, at least one decode window, first token, terminal); a
+    rejected one only its admission verdict; cancelled/expired/error at
+    minimum the queue time they burned before dying."""
+    problems: List[str] = []
+    short = trace_id[:12]
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in spans:
+        by_name.setdefault(ev["name"], []).append(ev)
+    roots = by_name.get(_ROOT, [])
+    if len(roots) != 1:
+        problems.append(f"trace {short}: {len(roots)} root spans (want 1)")
+        return problems  # nothing else is checkable without the root
+    root = roots[0]
+    root_sid = root["args"].get("span_id")
+    status = root["args"].get("status")
+    terminals = by_name.get(_TERMINAL, [])
+    if len(terminals) != 1:
+        problems.append(f"trace {short}: {len(terminals)} terminal events (want 1)")
+    elif terminals[0]["args"].get("status") != status:
+        problems.append(
+            f"trace {short}: terminal status "
+            f"{terminals[0]['args'].get('status')!r} != root {status!r}"
+        )
+    for ev in spans:
+        if ev is root:
+            continue
+        if ev["args"].get("parent_span_id") != root_sid:
+            problems.append(
+                f"trace {short}: {ev['name']} span not parented to root"
+            )
+    need = {
+        "done": ("req.queue", "req.prefill", "req.window",
+                 "req.first_token", _TERMINAL),
+        "rejected": ("req.admission", _TERMINAL),
+    }.get(status, ("req.queue", _TERMINAL))
+    for name in need:
+        if name not in by_name:
+            problems.append(
+                f"trace {short} ({status}): missing {name} span"
+            )
+    return problems
+
+
+def request_waterfall(trace_id: str, spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One request's latency decomposition. Segments sum to the root e2e
+    exactly: decode is the UNION of the (possibly overlapping) window
+    intervals, host_blocked is carved out of it from the per-window
+    ``host_blocked_s`` meta, and ``other`` is the residual no child span
+    claims (scheduler turnaround, token reap-to-notify, SSE write)."""
+    root = next(ev for ev in spans if ev["name"] == _ROOT)
+    r0, r1 = root["ts"], root["ts"] + root["dur"]
+
+    def clipped(name: str) -> List[Tuple[float, float]]:
+        return [
+            (max(ev["ts"], r0), min(ev["ts"] + ev["dur"], r1))
+            for ev in spans
+            if ev["name"] == name and ev["ts"] + ev["dur"] > r0 and ev["ts"] < r1
+        ]
+
+    e2e_s = root["dur"] / 1e6
+    queue_s = _union_s(clipped("req.queue"))
+    admission_s = _union_s(clipped("req.admission"))
+    prefill_s = _union_s(clipped("req.prefill"))
+    windows = [ev for ev in spans if ev["name"] == "req.window"]
+    decode_union_s = _union_s(clipped("req.window"))
+    host_blocked_s = min(
+        decode_union_s,
+        sum(float(ev["args"].get("host_blocked_s", 0.0)) for ev in windows),
+    )
+    claimed = queue_s + admission_s + prefill_s + decode_union_s
+    segments = {
+        "queue_s": queue_s,
+        "admission_s": admission_s,
+        "prefill_s": prefill_s,
+        "decode_s": decode_union_s - host_blocked_s,
+        "host_blocked_s": host_blocked_s,
+        "other_s": max(0.0, e2e_s - claimed),
+    }
+    first = [ev for ev in spans if ev["name"] == "req.first_token"]
+    out = {
+        "trace_id": trace_id,
+        "status": root["args"].get("status"),
+        "e2e_s": e2e_s,
+        "ttft_s": (min(ev["ts"] for ev in first) - r0) / 1e6 if first else None,
+        "n_windows": len(windows),
+        "segments": segments,
+        # >0 means child spans overlapped beyond the model (a tracer bug);
+        # the acceptance bound is |error| <= 1% of e2e.
+        "sum_error_s": sum(segments.values()) - e2e_s,
+    }
+    return out
+
+
+def _tail(vals: List[float]) -> Dict[str, float]:
+    """Bucket-estimated tail percentiles via the SAME histogram class the
+    live /metrics endpoint uses — the offline report and the dashboard
+    quantiles disagree only by bucket width, never by method."""
+    h = Histogram("tail", {}, buckets=DEFAULT_LATENCY_BUCKETS)
+    for v in vals:
+        h.observe(v)
+    if not vals:
+        return {}
+    return {q: h.percentile(p) for q, p in
+            (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))}
+
+
+def build_slo_report(
+    trace: Dict[str, Any],
+    *,
+    slo_ttft_s: float = 0.0,
+    slo_e2e_s: float = 0.0,
+) -> Dict[str, Any]:
+    """Fold a trace export into the per-request SLO attribution view."""
+    groups = group_request_spans(trace)
+    problems: List[str] = []
+    waterfalls: List[Dict[str, Any]] = []
+    for trace_id, spans in sorted(groups.items()):
+        ps = check_trace_tree(trace_id, spans)
+        problems.extend(ps)
+        if any(ev["name"] == _ROOT for ev in spans):
+            waterfalls.append(request_waterfall(trace_id, spans))
+    waterfalls.sort(key=lambda w: w["e2e_s"], reverse=True)
+
+    def _missed(w: Dict[str, Any]) -> Optional[str]:
+        if w["status"] != "done":
+            return f"status={w['status']}"
+        if slo_ttft_s > 0 and (w["ttft_s"] is None or w["ttft_s"] > slo_ttft_s):
+            return f"ttft {w['ttft_s']:.3f}s > {slo_ttft_s}s" if w["ttft_s"] \
+                is not None else "no first token"
+        if slo_e2e_s > 0 and w["e2e_s"] > slo_e2e_s:
+            return f"e2e {w['e2e_s']:.3f}s > {slo_e2e_s}s"
+        return None
+
+    misses = []
+    for w in waterfalls:
+        why = _missed(w)
+        if why is None:
+            continue
+        dominant = max(w["segments"], key=lambda k: w["segments"][k])
+        misses.append({**w, "why": why, "dominant_segment": dominant})
+    done = [w for w in waterfalls if w["status"] == "done"]
+    dropped = int((trace.get("otherData") or {}).get("dropped_spans", 0))
+    return {
+        "n_traces": len(groups),
+        "n_done": len(done),
+        "statuses": {
+            s: sum(1 for w in waterfalls if w["status"] == s)
+            for s in sorted({w["status"] for w in waterfalls} - {None})
+        },
+        "slo": {"ttft_s": slo_ttft_s, "e2e_s": slo_e2e_s},
+        "misses": misses,
+        "waterfalls": waterfalls,
+        "tails": {
+            "e2e_s": _tail([w["e2e_s"] for w in done]),
+            "ttft_s": _tail([w["ttft_s"] for w in done if w["ttft_s"] is not None]),
+        },
+        "max_sum_error_s": max(
+            (abs(w["sum_error_s"]) for w in waterfalls), default=0.0
+        ),
+        "dropped_spans": dropped,
+        "problems": problems,
+    }
+
+
+_SEG_ORDER = ("queue_s", "admission_s", "prefill_s", "decode_s",
+              "host_blocked_s", "other_s")
+
+
+def print_slo_report(report: Dict[str, Any]) -> None:
+    print("== serving slo ==")
+    print(
+        f"traces={report['n_traces']} done={report['n_done']} "
+        f"statuses={report['statuses']}"
+    )
+    for metric, tails in report["tails"].items():
+        if tails:
+            print(
+                f"  {metric:<8} " + " ".join(
+                    f"{q}={v:.4f}s" for q, v in tails.items()
+                )
+            )
+    if report["dropped_spans"]:
+        print(
+            f"!! trace is INCOMPLETE: {report['dropped_spans']} spans "
+            f"dropped at record time — waterfalls below may be partial",
+        )
+    print("== waterfalls (slowest first) ==")
+    hdr = "  trace_id      status     e2e_s " + " ".join(
+        f"{s[:-2]:>9}" for s in _SEG_ORDER
+    )
+    print(hdr)
+    for w in report["waterfalls"][:20]:
+        segs = " ".join(f"{w['segments'][s]:9.4f}" for s in _SEG_ORDER)
+        print(
+            f"  {w['trace_id'][:12]:<12} {w['status'] or '?':<9} "
+            f"{w['e2e_s']:6.3f} {segs}"
+        )
+    if len(report["waterfalls"]) > 20:
+        print(f"  ... {len(report['waterfalls']) - 20} more")
+    if report["misses"]:
+        print("== slo misses: why ==")
+        for m in report["misses"]:
+            seg = m["dominant_segment"]
+            print(
+                f"  {m['trace_id'][:12]:<12} {m['why']:<28} dominant="
+                f"{seg[:-2]} ({m['segments'][seg]:.3f}s of {m['e2e_s']:.3f}s)"
+            )
+    elif report["slo"]["ttft_s"] or report["slo"]["e2e_s"]:
+        print("== slo misses: none ==")
+    for p in report["problems"]:
+        print(f"!! {p}")
+
+
 def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     events, metrics = split_records(records)
     counts: Dict[str, int] = {}
@@ -193,14 +471,37 @@ def print_report(report: Dict[str, Any]) -> None:
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
-    parser.add_argument("paths", nargs="+", help="metrics/events JSONL files")
+    parser.add_argument("paths", nargs="*", help="metrics/events JSONL files")
     parser.add_argument(
         "--strict", action="store_true",
-        help="exit nonzero if any line fails to parse (CI schema gate)",
+        help="exit nonzero if any line fails to parse (CI schema gate) or, "
+        "with --slo, if any request's span tree is incomplete",
     )
     parser.add_argument("--json", action="store_true", help="machine-readable output")
     parser.add_argument("--bins", type=int, default=10, help="step-time histogram bins")
+    parser.add_argument(
+        "--trace", default="",
+        help="Chrome-trace JSON export (scripts/serve.py --trace) to "
+        "reconstruct per-request span trees from",
+    )
+    parser.add_argument(
+        "--slo", action="store_true",
+        help="per-request SLO attribution from --trace: waterfalls, "
+        "segment decomposition, miss table",
+    )
+    parser.add_argument(
+        "--slo_ttft_s", type=float, default=0.0,
+        help="TTFT SLO bound in seconds (0 = no bound)",
+    )
+    parser.add_argument(
+        "--slo_e2e_s", type=float, default=0.0,
+        help="end-to-end SLO bound in seconds (0 = no bound)",
+    )
     args = parser.parse_args()
+    if args.slo and not args.trace:
+        parser.error("--slo needs --trace")
+    if not args.paths and not args.trace:
+        parser.error("nothing to analyze: pass JSONL paths and/or --trace")
 
     records: List[Dict[str, Any]] = []
     bad = 0
@@ -210,13 +511,34 @@ def main() -> int:
         bad += nbad
     report = build_report(records, args.bins)
     report["bad_lines"] = bad
+    slo_report: Optional[Dict[str, Any]] = None
+    if args.trace:
+        trace = load_trace(args.trace)
+        slo_report = build_slo_report(
+            trace, slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s
+        )
+        report["serving"] = slo_report
     if args.json:
         print(json.dumps(report, indent=2, allow_nan=False))
     else:
-        print_report(report)
+        if args.paths:
+            print_report(report)
+        if slo_report is not None and (args.slo or slo_report["problems"]):
+            print_slo_report(slo_report)
         if bad:
             print(f"!! {bad} unparseable line(s)", file=sys.stderr)
+        if slo_report is not None and slo_report["dropped_spans"]:
+            print(
+                f"!! {slo_report['dropped_spans']} dropped span(s): the "
+                f"recorder saturated; raise max_events or sample fewer "
+                f"requests",
+                file=sys.stderr,
+            )
     if args.strict and bad:
+        return 1
+    if args.strict and slo_report is not None and slo_report["problems"]:
+        for p in slo_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
         return 1
     return 0
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -102,6 +103,17 @@ def main() -> None:
                         "0 = none (default: config)")
     parser.add_argument("--events", default="",
                         help="(--http) request-lifecycle events JSONL path")
+    parser.add_argument("--trace", default=None,
+                        help="(--http) Chrome-trace JSON export path, "
+                        "written at shutdown; implies --trace_sample 1.0 "
+                        "unless set explicitly (default: config)")
+    parser.add_argument("--trace_sample", type=float, default=None,
+                        help="(--http) per-request tracing head-sample "
+                        "fraction in [0, 1]; 0 = off (default: config)")
+    parser.add_argument("--healthz_stale_after_s", type=float, default=None,
+                        help="(--http) /healthz returns 503 once the engine "
+                        "loop has not completed a scheduler turn for this "
+                        "many seconds; 0 = disabled (default: config)")
     args = parser.parse_args()
     if not args.http and not args.input_file:
         parser.error("--input_file is required unless --http is set")
@@ -195,6 +207,9 @@ def _serve_http(args, cfg, eng, enc) -> None:
     from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
     from pretraining_llm_tpu.frontend.gateway import ServingGateway
     from pretraining_llm_tpu.observability.events import EventBus
+    from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+    from pretraining_llm_tpu.observability.spans import get_recorder
+    from pretraining_llm_tpu.observability.tracing import Tracer
 
     fc = cfg.frontend
 
@@ -202,6 +217,14 @@ def _serve_http(args, cfg, eng, enc) -> None:
         return cfg_val if cli_val is None else cli_val
 
     bus = EventBus(jsonl_path=args.events) if args.events else None
+    trace_path = pick(args.trace, fc.trace_path)
+    trace_sample = pick(args.trace_sample, fc.trace_sample)
+    if args.trace is not None and args.trace_sample is None:
+        trace_sample = 1.0  # asking for an export implies sampling
+    tracer = None
+    if trace_sample > 0:
+        tracer = Tracer(get_recorder(), sample=trace_sample, seed=args.seed)
+    registry = MetricsRegistry(prefix="pllm_serving_")
     admission = AdmissionController(
         max_queue_depth=pick(args.max_queue_depth, fc.max_queue_depth),
         max_outstanding_tokens=pick(
@@ -209,9 +232,11 @@ def _serve_http(args, cfg, eng, enc) -> None:
         ),
         retry_after_s=fc.retry_after_s,
         shed_infeasible=fc.shed_infeasible,
+        registry=registry,
     )
     loop = EngineLoop(
-        eng, admission=admission, bus=bus, idle_wait_s=fc.idle_wait_s
+        eng, admission=admission, bus=bus, idle_wait_s=fc.idle_wait_s,
+        tracer=tracer, registry=registry,
     ).start()
     gateway = ServingGateway(
         loop,
@@ -220,12 +245,22 @@ def _serve_http(args, cfg, eng, enc) -> None:
         encode=enc.encode_ordinary,
         decode=enc.decode,
         default_deadline_s=pick(args.default_deadline_s, fc.default_deadline_s),
+        healthz_stale_after_s=pick(
+            args.healthz_stale_after_s, fc.healthz_stale_after_s
+        ),
     )
     print(
         f"[serve] gateway listening on http://{gateway._server.server_address[0]}"
         f":{gateway.port} — POST /v1/generate, GET /healthz, GET /metrics",
         file=sys.stderr,
     )
+    # SIGTERM (a plain `kill`, the orchestrator's stop signal) must take
+    # the same graceful path as ^C: without this the process dies before
+    # the finally block and the whole trace export is lost.
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
         gateway.serve_forever()
     except KeyboardInterrupt:
@@ -235,6 +270,11 @@ def _serve_http(args, cfg, eng, enc) -> None:
         loop.stop()
         if bus is not None:
             bus.close()
+        if tracer is not None and trace_path:
+            path = tracer.recorder.export(trace_path)
+            dropped = tracer.recorder.dropped
+            extra = f" ({dropped} spans DROPPED)" if dropped else ""
+            print(f"[serve] trace written to {path}{extra}", file=sys.stderr)
         print(f"[serve] shut down — {loop.counters}", file=sys.stderr)
 
 

@@ -12,6 +12,7 @@ boundaries on the hot path.
 import dataclasses
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -21,8 +22,24 @@ import pytest
 from pretraining_llm_tpu.config import ObservabilityConfig, get_preset
 from pretraining_llm_tpu.observability.events import EventBus, json_line, sanitize_record
 from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
+from pretraining_llm_tpu.observability.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+)
 from pretraining_llm_tpu.observability.spans import SpanRecorder
-from pretraining_llm_tpu.observability.export import prometheus_lines, write_textfile
+from pretraining_llm_tpu.observability.export import (
+    lint_exposition,
+    prometheus_lines,
+    write_textfile,
+)
+from pretraining_llm_tpu.observability.tracing import (
+    RequestTrace,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
 from pretraining_llm_tpu.observability.device import CompileWatcher
 from pretraining_llm_tpu.observability.hub import ObservabilityHub
 from pretraining_llm_tpu.training.metrics import MetricsLogger, Throughput
@@ -729,6 +746,360 @@ def test_observability_config_validates_and_overrides():
     raw = json.loads(cfg.to_json()) if hasattr(cfg, "to_json") else None
     if raw is not None:
         assert raw["obs"]["events_path"] == "/tmp/e.jsonl"
+
+
+# ------------------------------------------------- typed metrics registry
+
+
+def test_registry_render_is_lint_clean():
+    reg = MetricsRegistry(prefix="pllm_serving_")
+    reg.counter("requests_terminal_total", "terminal requests", status="done").inc(3)
+    reg.counter("requests_terminal_total", status="cancelled").inc()
+    reg.gauge("queue_depth", "waiting requests").set(2)
+    h = reg.histogram("ttft_seconds", "time to first token")
+    for v in (0.001, 0.02, 0.3, 4.0):
+        h.observe(v)
+    text = reg.render(extra_gauges={"active_requests": 1, "note": "skip-me"})
+    assert lint_exposition(text) == [], lint_exposition(text)
+    # One TYPE header covers both labeled counter children.
+    assert text.count("# TYPE pllm_serving_requests_terminal_total counter") == 1
+    assert 'pllm_serving_requests_terminal_total{status="done"} 3.0' in text
+    assert "pllm_serving_ttft_seconds_count 4.0" in text
+    assert 'le="+Inf"' in text
+    # Extra gauges ride along under the prefix; non-numeric values skipped.
+    assert "# TYPE pllm_serving_active_requests gauge" in text
+    assert "note" not in text
+
+
+def test_registry_enforces_naming_and_kinds():
+    reg = MetricsRegistry(prefix="p_")
+    with pytest.raises(ValueError, match="_total"):
+        reg.counter("requests")
+    with pytest.raises(ValueError, match="collides"):
+        reg.histogram("latency_bucket")
+    # Re-registering the same name as another kind is an error.
+    reg2 = MetricsRegistry()
+    reg2.gauge("x")
+    with pytest.raises(ValueError, match="already registered as gauge"):
+        reg2.histogram("x")
+    c = reg.counter("ok_total")
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    # Get-or-create: the same (name, labels) returns the same object.
+    assert reg.counter("ok_total") is c
+    with pytest.raises(ValueError, match="sorted"):
+        Histogram("h", {}, buckets=(2.0, 1.0))
+    assert log_buckets(0.001, 0.01)[-1] >= 0.01
+    with pytest.raises(ValueError):
+        log_buckets(0.0, 1.0)
+
+
+def test_histogram_percentile_vs_nearest_rank():
+    """Property: the bucket-interpolated quantile differs from the exact
+    nearest-rank quantile (loadgen's _percentile) by at most the width of
+    the bucket the exact value fell in — the documented error bound."""
+    from pretraining_llm_tpu.frontend.loadgen import _percentile
+
+    rng = random.Random(7)
+    for trial in range(5):
+        vals = sorted(
+            min(80.0, rng.expovariate(1.0 / 0.05) + rng.random() * 0.001)
+            for _ in range(257)
+        )
+        h = Histogram("lat", {}, buckets=DEFAULT_LATENCY_BUCKETS)
+        for v in vals:
+            h.observe(v)
+        for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+            exact = _percentile(vals, q)
+            est = h.percentile(q)
+            # Width of the bucket containing the exact value.
+            bounds = (0.0,) + DEFAULT_LATENCY_BUCKETS
+            i = next(
+                (j for j in range(1, len(bounds)) if exact <= bounds[j]),
+                len(bounds) - 1,
+            )
+            width = bounds[i] - bounds[i - 1]
+            assert abs(est - exact) <= width + 1e-12, (trial, q, exact, est)
+            assert vals[0] <= est <= vals[-1]  # clamped to the data range
+
+
+def test_histogram_low_outliers_never_lost():
+    h = Histogram("h", {}, buckets=(0.1, 1.0))
+    h.observe(-0.5)  # clock artifact
+    h.observe(0.0)
+    h.observe(5.0)  # overflow
+    assert h.count == 3
+    samples = dict(
+        ((name, labels["le"]), v) for name, labels, v in h.samples()
+        if name.endswith("_bucket")
+    )
+    assert samples[("h_bucket", "0.1")] == 2.0
+    assert samples[("h_bucket", "+Inf")] == 3.0
+    # Estimates stay inside the observed range even with outliers on both
+    # sides of the bucket bounds.
+    assert -0.5 <= h.percentile(0.0) <= 0.1
+    assert h.percentile(1.0) == pytest.approx(5.0)
+
+
+def test_prometheus_lines_typed_counters():
+    text = prometheus_lines(
+        {"requests": 4, "depth": 2},
+        prefix="p_",
+        types={"requests": "counter"},
+    )
+    assert "# TYPE p_requests_total counter" in text
+    assert "p_requests_total 4.0" in text
+    assert "# TYPE p_depth gauge" in text
+    assert lint_exposition(text) == []
+    with pytest.raises(ValueError, match="unsupported series type"):
+        prometheus_lines({"x": 1}, types={"x": "histogram"})
+
+
+def test_lint_exposition_flags_contract_violations():
+    assert lint_exposition("") == []
+    bad = {
+        "counter w/o _total": "# TYPE a_requests counter\na_requests 1.0\n",
+        "gauge named _total": "# TYPE a_x_total gauge\na_x_total 1.0\n",
+        "TYPE after sample": "a_x 1.0\n# TYPE a_x gauge\na_x 2.0\n",
+        "duplicate TYPE": "# TYPE a_x gauge\n# TYPE a_x gauge\na_x 1.0\n",
+        "untyped sample": "# TYPE a_x gauge\na_x 1.0\na_y 2.0\n",
+        "unparseable": "# TYPE a_x gauge\na_x one\n",
+        "no +Inf": (
+            "# TYPE h histogram\nh_bucket{le=\"1\"} 1.0\n"
+            "h_sum 0.5\nh_count 1.0\n"
+        ),
+        "not cumulative": (
+            "# TYPE h histogram\nh_bucket{le=\"1\"} 2.0\n"
+            "h_bucket{le=\"+Inf\"} 1.0\nh_sum 0.5\nh_count 1.0\n"
+        ),
+        "+Inf != count": (
+            "# TYPE h histogram\nh_bucket{le=\"1\"} 1.0\n"
+            "h_bucket{le=\"+Inf\"} 2.0\nh_sum 0.5\nh_count 3.0\n"
+        ),
+        "missing _sum": (
+            "# TYPE h histogram\nh_bucket{le=\"1\"} 1.0\n"
+            "h_bucket{le=\"+Inf\"} 1.0\nh_count 1.0\n"
+        ),
+    }
+    for why, text in bad.items():
+        assert lint_exposition(text), f"lint missed: {why}"
+    good = (
+        "# HELP h latency\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1.0\nh_bucket{le="1"} 3.0\n'
+        'h_bucket{le="+Inf"} 4.0\nh_sum 2.5\nh_count 4.0\n'
+        "# TYPE a_total counter\na_total 7.0\n"
+    )
+    assert lint_exposition(good) == []
+
+
+# -------------------------------------------------------- request tracing
+
+
+def test_traceparent_parse_and_format():
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    ctx = parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ctx.trace_id == tid and ctx.span_id == sid and ctx.sampled
+    assert not parse_traceparent(f"00-{tid}-{sid}-00").sampled
+    assert format_traceparent(ctx) == f"00-{tid}-{sid}-01"
+    # Uppercase hex is tolerated (lowered), per the robustness clause.
+    assert parse_traceparent(f"00-{tid.upper()}-{sid}-01") is not None
+    for bad in (
+        None, "", "garbage", f"00-{tid}-{sid}", f"ff-{tid}-{sid}-01",
+        f"00-{'0' * 32}-{sid}-01", f"00-{tid}-{'0' * 16}-01",
+        f"00-{tid[:-1]}-{sid}-01",
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_request_trace_tree_and_chrome_export():
+    rec = SpanRecorder()
+    tracer = Tracer(rec, sample=1.0, seed=11)
+    tr = tracer.begin_request()
+    t0 = tr.t0
+    tr.span("req.queue", t0, t0 + 0.01, outcome="admitted")
+    tr.event("req.first_token")
+    assert tr.finish("done", n_tokens=4)
+    assert not tr.finish("done")  # idempotent: one root per trace
+    trace = rec.to_chrome_trace()
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    root = next(e for e in spans if e["name"] == "req.request")
+    assert root["args"]["status"] == "done" and root["args"]["n_tokens"] == 4
+    for child in spans:
+        if child is not root:
+            assert child["args"]["parent_span_id"] == root["args"]["span_id"]
+        assert child["args"]["trace_id"] == tr.trace_id
+    # Every request renders on its own named virtual track.
+    names = [
+        e["args"]["name"] for e in trace["traceEvents"] if e.get("ph") == "M"
+    ]
+    assert f"req {tr.trace_id[:12]}" in names
+    assert trace["otherData"]["dropped_spans"] == 0
+
+
+def test_tracer_sampling_and_inbound_join():
+    rec = SpanRecorder()
+    assert Tracer(rec, sample=0.0).begin_request() is None
+    with pytest.raises(ValueError):
+        Tracer(rec, sample=1.5)
+    tracer = Tracer(rec, sample=0.0, seed=1)
+    # An inbound sampled header overrides head-sampling (caller decided)...
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    tr = tracer.begin_request(f"00-{tid}-b7ad6b7169203331-01")
+    assert tr is not None and tr.trace_id == tid
+    assert tr.parent_id == "b7ad6b7169203331"
+    # ...and an inbound UNsampled header suppresses even sample=1.0.
+    full = Tracer(rec, sample=1.0, seed=1)
+    assert full.begin_request(f"00-{tid}-b7ad6b7169203331-00") is None
+    # Seeded tracers mint deterministic ids.
+    a = Tracer(SpanRecorder(), sample=1.0, seed=5).begin_request()
+    b = Tracer(SpanRecorder(), sample=1.0, seed=5).begin_request()
+    assert a.trace_id == b.trace_id and a.root_id == b.root_id
+
+
+def test_span_recorder_surfaces_drops_in_trace():
+    rec = SpanRecorder(max_events=2)
+    for i in range(5):
+        rec.record(f"s{i}", 0.0, 0.001)
+    assert rec.dropped == 3
+    trace = rec.to_chrome_trace()
+    assert trace["otherData"]["dropped_spans"] == 3
+    instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert instants and instants[-1]["name"] == "spans_dropped"
+    assert instants[-1]["args"]["dropped"] == 3
+
+
+def _request_trace_fixture(statuses):
+    """A recorder holding one complete span tree per (status, idx)."""
+    import time as _time
+
+    rec = SpanRecorder()
+    tracer = Tracer(rec, sample=1.0, seed=3)
+    for status in statuses:
+        tr = tracer.begin_request()
+        # Backdate the trace so the synthetic child offsets below land
+        # INSIDE the root span [t0, finish-time] — finish() reads the
+        # real clock.
+        tr.t0 = _time.perf_counter() - 0.25
+        tr.marks["start"] = tr.t0
+        t0 = tr.t0
+        if status == "rejected":
+            tr.span("req.admission", t0, t0 + 0.001, outcome="rejected")
+            tr.finish("rejected", reason="busy")
+            continue
+        tr.span("req.admission", t0, t0 + 0.0005, outcome="admitted")
+        tr.span("req.queue", t0, t0 + 0.02, outcome=status)
+        if status == "done":
+            tr.span("req.prefill", t0 + 0.02, t0 + 0.03, n_prompt=5)
+            tr.span("req.window", t0 + 0.03, t0 + 0.08,
+                    steps=4, host_blocked_s=0.01)
+            tr.span("req.window", t0 + 0.06, t0 + 0.1,
+                    steps=4, host_blocked_s=0.005)
+            tr.event("req.first_token")
+        tr.finish(status)
+    return rec
+
+
+def test_obs_report_slo_attribution(tmp_path):
+    rec = _request_trace_fixture(["done", "done", "expired", "rejected"])
+    trace_path = tmp_path / "trace.json"
+    rec.export(str(trace_path))
+    res = subprocess.run(
+        [
+            sys.executable, OBS_REPORT, "--json", "--strict", "--slo",
+            "--trace", str(trace_path), "--slo_e2e_s", "0.001",
+        ],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0, res.stderr
+    serving = json.loads(res.stdout)["serving"]
+    assert serving["n_traces"] == 4 and serving["problems"] == []
+    assert serving["statuses"] == {"done": 2, "expired": 1, "rejected": 1}
+    # Overlapping decode windows are unioned, not summed: [0.03, 0.1].
+    done = [w for w in serving["waterfalls"] if w["status"] == "done"]
+    for w in done:
+        segs = w["segments"]
+        assert segs["decode_s"] + segs["host_blocked_s"] == pytest.approx(
+            0.07, rel=0.05
+        )
+        assert segs["host_blocked_s"] == pytest.approx(0.015, rel=0.05)
+        # The decomposition sums to the root e2e (acceptance bound: 1%).
+        assert abs(w["sum_error_s"]) <= 0.01 * w["e2e_s"] + 1e-9
+    # Everything misses the absurd 1ms SLO; each miss names its dominant
+    # segment (the "why we missed" attribution).
+    assert len(serving["misses"]) == 4
+    assert all(m["dominant_segment"] for m in serving["misses"])
+    assert serving["tails"]["e2e_s"]["p99"] > 0
+
+
+def test_obs_report_strict_fails_on_incomplete_tree(tmp_path):
+    rec = _request_trace_fixture(["done"])
+    trace = rec.to_chrome_trace()
+    # Sever the tree: drop the terminal event.
+    trace["traceEvents"] = [
+        e for e in trace["traceEvents"] if e["name"] != "req.terminal"
+    ]
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(json.dumps(trace))
+    res = subprocess.run(
+        [
+            sys.executable, OBS_REPORT, "--strict", "--slo",
+            "--trace", str(trace_path),
+        ],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 1
+    assert "terminal" in res.stderr
+    # Without --strict the same input reports and exits 0.
+    lax = subprocess.run(
+        [sys.executable, OBS_REPORT, "--slo", "--trace", str(trace_path)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert lax.returncode == 0
+
+
+def test_obs_report_warns_on_dropped_spans(tmp_path):
+    rec = SpanRecorder(max_events=1)
+    tracer = Tracer(rec, sample=1.0, seed=3)
+    tr = tracer.begin_request()
+    tr.span("req.queue", tr.t0, tr.t0 + 0.01)
+    tr.finish("done")  # terminal + root dropped: recorder is full
+    trace_path = tmp_path / "trace.json"
+    rec.export(str(trace_path))
+    res = subprocess.run(
+        [sys.executable, OBS_REPORT, "--trace", str(trace_path)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0
+    assert "dropped" in res.stderr
+
+
+def test_hub_registry_typed_textfile(tmp_path):
+    """The trainer hub's textfile export carries the typed series (window
+    histogram + dropped-spans counter) alongside the flat gauges, and the
+    whole body passes the exposition lint."""
+    path = tmp_path / "m.prom"
+    hub = ObservabilityHub(ObservabilityConfig(prometheus_path=str(path)))
+    hub.spans.max_events = 0  # force drops
+    with hub.spans.span("x"):
+        pass
+    hub.on_log_boundary(4, {"window_s": 1.25, "window_steps": 4},
+                        {"loss": 3.0})
+    text = path.read_text()
+    assert lint_exposition(text) == [], lint_exposition(text)
+    assert "# TYPE pllm_step_window_seconds histogram" in text
+    assert "pllm_step_window_seconds_count 1.0" in text
+    assert "pllm_spans_dropped_total 1.0" in text
+    assert "# TYPE pllm_loss gauge" in text
+    # The counter tracks the recorder's drop tally as a delta, not a reset.
+    with hub.spans.span("y"):
+        pass
+    hub.on_log_boundary(8, {"window_s": 1.0, "window_steps": 4},
+                        {"loss": 2.9})
+    assert "pllm_spans_dropped_total 2.0" in path.read_text()
 
 
 def test_hub_timed_event_attaches_fields():
