@@ -212,6 +212,26 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--n-requests", type=int, default=0,
         help="serving-slo mode: workload size (0 = 3x max_batch)",
     )
+    parser.add_argument(
+        "--prefix-cache", action="store_true",
+        help="serving/serving-slo mode: cross-request prefix cache "
+        "(content-addressed shared KV blocks; greedy outputs unchanged)",
+    )
+    parser.add_argument(
+        "--prefix-pool-size", type=int, default=0,
+        help="serving-slo mode: hot-prefix scenario — pool of shared "
+        "prefixes each request draws from (0 = off)",
+    )
+    parser.add_argument(
+        "--prefix-len", type=int, default=0,
+        help="serving-slo mode: shared-prefix length in tokens "
+        "(0 = 2x block_size when a pool is set)",
+    )
+    parser.add_argument(
+        "--prefix-zipf", type=float, default=1.0,
+        help="serving-slo mode: zipf skew over prefix-pool rank "
+        "(0 = uniform, larger = hotter head)",
+    )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument(
@@ -294,6 +314,9 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "--pipeline-depth": args.pipeline_depth,
         "--admit-batch": args.admit_batch,
         "--grad-dtype": args.grad_dtype,
+        "--prefix-cache": args.prefix_cache,
+        "--prefix-pool-size": args.prefix_pool_size,
+        "--prefix-len": args.prefix_len,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -393,6 +416,10 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "--block-q": args.block_q, "--block-kv": args.block_kv,
         "--ragged": args.ragged, "--decode-unroll": args.decode_unroll,
         "--context": args.context, "--grad-dtype": args.grad_dtype,
+        # Hot-prefix traffic shaping lives in the SLO loadgen; this
+        # mode's fixed request set would silently ignore it.
+        "--prefix-pool-size": args.prefix_pool_size,
+        "--prefix-len": args.prefix_len,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -445,7 +472,8 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
             # the historical temperature=1.0 series.
             temperature=0.0 if spec else 1.0,
             steps_per_sched=sps, pipeline_depth=depth,
-            admit_batch=args.admit_batch, **spec,
+            admit_batch=args.admit_batch,
+            prefix_cache=args.prefix_cache, **spec,
         )
         rids = [eng.submit(p, new_tokens) for p in prompts]
         out = eng.run(pipeline=not args.no_pipeline)
@@ -487,6 +515,9 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
     if spec:
         rec["metric"] += "_spec"  # self-draft upper-bound series
         rec["spec_k"] = args.spec_k
+    if args.prefix_cache:
+        rec["metric"] += "_pfx"  # distinct series vs the cache-off baseline
+        rec["prefix_cache"] = True
     if cfg.kv_cache_dtype == "int8":
         rec["metric"] += "_kvint8"
     if cfg.decode_cache_layout == "unstacked":
@@ -539,7 +570,28 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
     prompt_len = int(canon_prompt.shape[1])
     block_size = min(64, cfg.context_length)
     n_requests = args.n_requests or 3 * max_batch
-    pages_per_req = -(-(prompt_len + new_tokens) // block_size)
+    # Hot-prefix scenario: each request prepends a shared prefix drawn
+    # zipf-skewed from a fixed pool — the workload the prefix cache is
+    # built for. Shrink the private-prompt range if the prefix would
+    # otherwise push requests past the context window.
+    pfx_pool = args.prefix_pool_size
+    pfx_len = 0
+    if pfx_pool:
+        # Shared prefixes only pay off when they span whole pool blocks;
+        # with small contexts the default 64-token pages would make every
+        # prompt a single block (the cache caps hits one token short of
+        # the prompt, so a one-block prompt can never hit). Shrink pages
+        # so a prefix + private prompt + generation spans several.
+        block_size = min(block_size, max(8, cfg.context_length // 8))
+        pfx_len = args.prefix_len or 2 * block_size
+        room = cfg.context_length - new_tokens - pfx_len
+        if room < 1:
+            raise ValueError(
+                f"--prefix-len {pfx_len} leaves no room for prompts "
+                f"(context {cfg.context_length}, new_tokens {new_tokens})"
+            )
+        prompt_len = min(prompt_len, room)
+    pages_per_req = -(-(pfx_len + prompt_len + new_tokens) // block_size)
     n_blocks = max_batch * pages_per_req + max_batch + 1
 
     sps = args.steps_per_sched or 8
@@ -550,6 +602,7 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
         block_size=block_size, temperature=0.0,
         steps_per_sched=sps, pipeline_depth=depth,
         admit_batch=args.admit_batch,
+        prefix_cache=args.prefix_cache,
     )
     spec = LoadSpec(
         n_requests=n_requests, mode="open", rate_rps=args.rate_rps,
@@ -557,6 +610,8 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
         prompt_len_min=max(1, prompt_len // 4), prompt_len_max=prompt_len,
         max_new_min=new_tokens, max_new_max=new_tokens,
         slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s, seed=0,
+        prefix_pool_size=pfx_pool, prefix_len=pfx_len,
+        prefix_zipf=args.prefix_zipf,
     )
     admission = AdmissionController(max_queue_depth=4 * max_batch)
     loop = EngineLoop(eng, admission=admission)
@@ -567,7 +622,7 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
         warm.result()
         report = run_engine_loop(loop, spec)
     s = report.summary()
-    return {
+    rec = {
         "metric": f"serving_slo_goodput_{args.preset}",
         "value": round(s["goodput_rps"], 3),
         "unit": "slo_ok_requests_per_sec",
@@ -593,6 +648,30 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
         "wall_s": round(report.wall_s, 2),
         "device": jax.devices()[0].device_kind,
     }
+    if pfx_pool:
+        rec["metric"] += "_hotprefix"  # distinct series vs i.i.d. prompts
+        rec["prefix_pool_size"] = pfx_pool
+        rec["prefix_len"] = pfx_len
+        rec["prefix_zipf"] = args.prefix_zipf
+    if args.prefix_cache:
+        rec["metric"] += "_pfx"  # distinct series vs the cache-off baseline
+        hit_tok = eng.stats.get("prefix_cache_hit_tokens", 0)
+        prefill_tok = eng.stats.get("prefill_tokens", 0)
+        rec["prefix_cache"] = {
+            "hits": eng.stats.get("prefix_cache_hits", 0),
+            "misses": eng.stats.get("prefix_cache_misses", 0),
+            "hit_tokens": hit_tok,
+            "prefill_tokens": prefill_tok,
+            "evicted_blocks": eng.stats.get("prefix_cache_evicted_blocks", 0),
+            # Fraction of prompt tokens served from cache instead of
+            # prefill — the headline win on hot-prefix traffic.
+            "prefill_reduction": (
+                round(hit_tok / (hit_tok + prefill_tok), 4)
+                if hit_tok + prefill_tok else 0.0
+            ),
+            "cached_tokens_total": s["cached_tokens_total"],
+        }
+    return rec
 
 
 def run_trainer_bench(args: argparse.Namespace) -> dict:
@@ -607,7 +686,10 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
             "--context": args.context, "--paged-attn": args.paged_attn,
             "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
             "--pipeline-depth": args.pipeline_depth,
-            "--admit-batch": args.admit_batch}
+            "--admit-batch": args.admit_batch,
+            "--prefix-cache": args.prefix_cache,
+            "--prefix-pool-size": args.prefix_pool_size,
+            "--prefix-len": args.prefix_len}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the trainer path")
@@ -724,7 +806,10 @@ def run_bench(args: argparse.Namespace) -> dict:
             "--paged-attn": args.paged_attn,
             "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
             "--pipeline-depth": args.pipeline_depth,
-            "--admit-batch": args.admit_batch}
+            "--admit-batch": args.admit_batch,
+            "--prefix-cache": args.prefix_cache,
+            "--prefix-pool-size": args.prefix_pool_size,
+            "--prefix-len": args.prefix_len}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the train path")
@@ -1083,6 +1168,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--paged-attn", args.paged_attn]
     if args.spec_draft:
         cmd += ["--spec-draft", args.spec_draft, "--spec-k", str(args.spec_k)]
+    if args.prefix_cache:
+        cmd.append("--prefix-cache")
     if args.mode == "serving-slo":
         cmd += [
             "--rate-rps", str(args.rate_rps),
@@ -1090,6 +1177,13 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
             "--slo-e2e-s", str(args.slo_e2e_s),
             "--n-requests", str(args.n_requests),
         ]
+        if args.prefix_pool_size:
+            cmd += [
+                "--prefix-pool-size", str(args.prefix_pool_size),
+                "--prefix-zipf", str(args.prefix_zipf),
+            ]
+            if args.prefix_len:
+                cmd += ["--prefix-len", str(args.prefix_len)]
     if args.cache_layout:
         cmd += ["--cache-layout", args.cache_layout]
     if args.context:

@@ -747,6 +747,16 @@ class ServingConfig:
     # device still has active rows — an idle engine always admits
     # whatever fits, so batching can never deadlock the queue.
     admit_batch: int = 0
+    # Cross-request prefix cache (generation/prefix_cache.py): finished
+    # requests publish their full KV blocks into a content-addressed
+    # index; new admissions map the longest cached block-aligned prefix
+    # read-only and prefill only the uncached suffix. Cold cached blocks
+    # are LRU-evicted under pool pressure, before any live preemption.
+    # Off by default; greedy outputs are bit-identical either way.
+    prefix_cache: bool = False
+    # Shortest cached prefix (in blocks) worth mapping — below this the
+    # table-sharing bookkeeping outweighs the prefill saved.
+    prefix_cache_min_blocks: int = 1
 
     def __post_init__(self) -> None:
         if self.pipeline_depth < 1:
@@ -755,6 +765,11 @@ class ServingConfig:
             )
         if self.admit_batch < 0:
             raise ValueError(f"admit_batch must be >= 0, got {self.admit_batch}")
+        if self.prefix_cache_min_blocks < 1:
+            raise ValueError(
+                "prefix_cache_min_blocks must be >= 1, got "
+                f"{self.prefix_cache_min_blocks}"
+            )
 
 
 @dataclass(frozen=True)

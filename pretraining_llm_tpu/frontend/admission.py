@@ -138,10 +138,19 @@ class AdmissionController:
         n_prompt_tokens: int,
         max_new_tokens: int,
         deadline_s: Optional[float] = None,
+        cached_tokens: int = 0,
     ) -> Ticket:
         """Admit or raise. ``deadline_s`` is the request's REMAINING time
-        budget in seconds (None = no deadline)."""
-        cost = int(n_prompt_tokens) + int(max_new_tokens)
+        budget in seconds (None = no deadline). ``cached_tokens`` is the
+        engine's prefix-cache hint: prompt tokens already resident in
+        shared KV blocks cost no prefill and no new pool pages, so they
+        don't count against the outstanding-token budget — cache hits buy
+        admission headroom. Capped at n_prompt - 1 (the final prompt
+        token always prefills privately)."""
+        discount = min(
+            max(0, int(cached_tokens)), max(0, int(n_prompt_tokens) - 1)
+        )
+        cost = int(n_prompt_tokens) - discount + int(max_new_tokens)
         if self.shed_infeasible and deadline_s is not None:
             if deadline_s <= 0:
                 with self._lock:

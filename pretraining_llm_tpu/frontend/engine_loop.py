@@ -152,6 +152,9 @@ class EngineLoop:
                 "window_seconds", "decode-window dispatch -> reap wall time")
             engine.host_blocked_hist = registry.histogram(
                 "host_blocked_seconds", "host blocked on window readback")
+            cache = getattr(engine, "prefix_cache", None)
+            if cache is not None:
+                cache.bind(registry)
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         # Engine-loop liveness: monotonic time of the last completed
@@ -241,9 +244,21 @@ class EngineLoop:
         ticket = None
         t_adm = time.perf_counter()
         if self.admission is not None:
+            # Prefix-cache hint: tokens already resident in shared blocks
+            # won't charge the outstanding budget. peek() is lock-guarded
+            # and side-effect-free, so gateway threads may call it while
+            # the loop thread mutates the cache; the hint can go stale
+            # either way before the engine's own lookup, which only makes
+            # the discount conservative, never the budget unsound (the
+            # ticket stores whatever was charged).
+            cached = 0
+            cache = getattr(self.engine, "prefix_cache", None)
+            if cache is not None:
+                cached = cache.peek(prompt)
             try:
                 ticket = self.admission.try_admit(
-                    len(prompt), max_new, deadline_s=deadline_s
+                    len(prompt), max_new, deadline_s=deadline_s,
+                    cached_tokens=cached,
                 )
             except RejectedBusy as e:
                 self._rejected(trace, "busy", e.reason, trace_fields)

@@ -60,6 +60,16 @@ class LoadSpec:
     slo_ttft_s: float = 0.0
     slo_e2e_s: float = 0.0
     seed: int = 0
+    # Hot-prefix scenario (prefix-cache workloads): when
+    # ``prefix_pool_size`` > 0, a pool of that many shared prefixes (each
+    # ``prefix_len`` tokens, seeded like everything else) is materialised
+    # and every request PREPENDS one, drawn zipf(s=``prefix_zipf``) over
+    # pool rank — rank-1 is the hottest "system prompt", the tail is
+    # cold. 0 (the default) leaves schedules byte-identical to specs
+    # that predate these fields.
+    prefix_pool_size: int = 0
+    prefix_len: int = 0
+    prefix_zipf: float = 1.0
     # HTTP client only: send a seeded W3C ``traceparent`` header per
     # request (sampled flag set), so the gateway joins trace ids the
     # workload chose — outcomes then correlate with the server's trace
@@ -85,6 +95,19 @@ class LoadSpec:
             raise ValueError(
                 f"bad max_new range [{self.max_new_min}, {self.max_new_max}]"
             )
+        if self.prefix_pool_size < 0:
+            raise ValueError(
+                f"prefix_pool_size must be >= 0, got {self.prefix_pool_size}"
+            )
+        if self.prefix_pool_size > 0 and self.prefix_len < 1:
+            raise ValueError(
+                f"prefix_len must be >= 1 with a prefix pool, got "
+                f"{self.prefix_len}"
+            )
+        if self.prefix_zipf < 0:
+            raise ValueError(
+                f"prefix_zipf must be >= 0, got {self.prefix_zipf}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +122,20 @@ def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
     """Materialise the workload. Pure function of ``spec`` (seeded PRNG,
     no wall clock): call it twice, get the same schedule."""
     rng = random.Random(spec.seed)
+    # Shared-prefix pool + zipf-over-rank weights, materialised before
+    # the request loop so the rng is consumed ONLY when the scenario is
+    # on: pool-off schedules stay byte-identical to pre-pool specs.
+    pool: List[List[int]] = []
+    weights: List[float] = []
+    if spec.prefix_pool_size > 0:
+        pool = [
+            [rng.randrange(spec.vocab_size) for _ in range(spec.prefix_len)]
+            for _ in range(spec.prefix_pool_size)
+        ]
+        weights = [
+            1.0 / (rank ** spec.prefix_zipf)
+            for rank in range(1, spec.prefix_pool_size + 1)
+        ]
     out: List[ScheduledRequest] = []
     t = 0.0
     for i in range(spec.n_requests):
@@ -106,6 +143,8 @@ def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
             t += rng.expovariate(spec.rate_rps)
         n_prompt = rng.randint(spec.prompt_len_min, spec.prompt_len_max)
         prompt = [rng.randrange(spec.vocab_size) for _ in range(n_prompt)]
+        if pool:
+            prompt = pool[rng.choices(range(len(pool)), weights)[0]] + prompt
         max_new = rng.randint(spec.max_new_min, spec.max_new_max)
         out.append(
             ScheduledRequest(
@@ -127,6 +166,9 @@ class RequestOutcome:
     tpot_s: Optional[float] = None
     e2e_s: Optional[float] = None
     trace_id: Optional[str] = None
+    # Prompt tokens the engine served from the prefix cache (0 with the
+    # cache off; accumulates across preemption re-admissions).
+    cached_tokens: int = 0
 
 
 def traceparent_for(spec: LoadSpec, index: int) -> str:
@@ -195,6 +237,7 @@ class LoadReport:
             "throughput_tok_s": tokens / wall,
             "goodput_rps": n_ok / wall,
             "slo_attainment": (n_ok / len(self.outcomes)) if self.outcomes else 0.0,
+            "cached_tokens_total": sum(o.cached_tokens for o in self.outcomes),
             "ttft": self.percentiles("ttft_s"),
             "tpot": self.percentiles("tpot_s"),
             "e2e": self.percentiles("e2e_s"),
@@ -278,6 +321,7 @@ def run_engine_loop(loop: Any, spec: LoadSpec) -> LoadReport:
             tpot_s=info.get("tpot_s"),
             e2e_s=info.get("e2e_s", time.monotonic() - t0),
             trace_id=info.get("trace_id"),
+            cached_tokens=int(info.get("cached_tokens", 0)),
         )
 
     return _execute(spec, client)
@@ -337,6 +381,7 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
             tpot_s=body.get("tpot_s"),
             e2e_s=body.get("e2e_s", time.monotonic() - t0),
             trace_id=body.get("trace_id", trace_id),
+            cached_tokens=int(body.get("cached_tokens", 0)),
         )
 
     return _execute(spec, client)

@@ -389,6 +389,128 @@ def prefill_into_pool_batched(
     return toks[:n], pools
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "t_bucket", "temperature", "top_k", "top_p", "min_p", "mesh",
+    ),
+    donate_argnums=(1,),
+)
+def _suffix_prefill_sample(
+    params: Any,
+    pools: transformer.KVCache,
+    suffix: jax.Array,  # (N, t_bucket) int32, zero-padded rows
+    suffix_lens: jax.Array,  # (N,) int32 — true suffix lengths (>= 1)
+    block_tables: jax.Array,  # (N, max_blocks) int32 — shared + private ids
+    cached_lens: jax.Array,  # (N,) int32 — resident prefix length per row
+    key: jax.Array,
+    cfg: ModelConfig,
+    t_bucket: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """Prefix-cache hit admission: ONE multi-token paged forward over each
+    row's uncached suffix. Token j of row i writes its K/V at slot
+    cached_lens[i] + j through the row's table (landing only in the row's
+    PRIVATE suffix blocks — the hit cap guarantees cached_len is block-
+    aligned and strictly below the prompt), while attention gathers the
+    shared prefix pages read-only (the model's paged tq>1 branch masks
+    lin <= pos per query). The first output token samples from the last
+    real suffix position.
+
+    Pad tokens (rows shorter than the bucket) write slots >= the prompt
+    length — private pages above the frontier, overwritten by decode
+    before the mask ever exposes them, or scratch-redirected past the
+    table (the established slot-reuse discipline). Pad ROWS carry all-
+    zero tables and cached_len 0, so every write scatters to the reserved
+    scratch block 0.
+    """
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    n_rows = suffix.shape[0]
+    with activation_mesh(mesh):
+        logits, pools = transformer.forward(
+            params, suffix, cfg, kv_cache=pools,
+            paged=PagedInfo(block_tables, cached_lens),
+        )
+        idx = jnp.clip(suffix_lens - 1, 0, t_bucket - 1).astype(jnp.int32)
+        last = jnp.take_along_axis(
+            logits,
+            jnp.broadcast_to(idx[:, None, None], (n_rows, 1, logits.shape[-1])),
+            axis=1,
+        )[:, 0]
+        toks = sample_logits(
+            last, key, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p,
+        ).astype(jnp.int32)
+        return toks, pools
+
+
+def prefill_suffix_into_pool_batched(
+    params: Any,
+    cfg: ModelConfig,
+    pools: transformer.KVCache,
+    suffixes: Sequence[Sequence[int]],
+    tables_rows: Any,  # (N, max_blocks) int array — engine table rows
+    cached_lens: Sequence[int],
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """Prefill ONLY the uncached suffixes of N prefix-cache-hit prompts in
+    one device program; returns (first sampled token per row — a DEVICE
+    (N,) int32 array, no host sync — and the updated pools).
+
+    ``tables_rows[i]`` is row i's full block-table row (shared prefix
+    blocks followed by private suffix blocks, zero-padded);
+    ``cached_lens[i]`` its block-aligned resident prefix length. Rows and
+    suffix lengths bucket to powers of two, mirroring
+    ``prefill_into_pool_batched``'s jit-cache discipline.
+    """
+    import numpy as np
+
+    n = len(suffixes)
+    if n == 0:
+        raise ValueError("no suffixes")
+    if len(cached_lens) != n:
+        raise ValueError(f"{n} suffixes but {len(cached_lens)} cached_lens")
+    for i, s in enumerate(suffixes):
+        if len(s) == 0:
+            # The hit cap ((p-1)//bs blocks) makes this unreachable from
+            # the engine; guard it for direct callers.
+            raise ValueError(f"suffix {i} is empty (hit must be capped)")
+    tables_np = np.asarray(tables_rows, np.int32)
+    if tables_np.ndim != 2 or tables_np.shape[0] != n:
+        raise ValueError(
+            f"tables_rows must be (n={n}, max_blocks); got {tables_np.shape}"
+        )
+    max_t = max(len(s) for s in suffixes)
+    bucket_rows = 1 << (n - 1).bit_length()
+    t_bucket = 1 << (max_t - 1).bit_length()
+    suf_arr = np.zeros((bucket_rows, t_bucket), np.int32)
+    lens = np.ones((bucket_rows,), np.int32)
+    tab_arr = np.zeros((bucket_rows, tables_np.shape[1]), np.int32)
+    cl_arr = np.zeros((bucket_rows,), np.int32)
+    for i, s in enumerate(suffixes):
+        suf_arr[i, : len(s)] = s
+        lens[i] = len(s)
+        tab_arr[i] = tables_np[i]
+        cl_arr[i] = int(cached_lens[i])
+    toks, pools = _suffix_prefill_sample(
+        params, pools, jnp.asarray(suf_arr), jnp.asarray(lens),
+        jnp.asarray(tab_arr), jnp.asarray(cl_arr), key, cfg, t_bucket,
+        temperature, top_k, top_p, min_p, mesh,
+    )
+    return toks[:n], pools
+
+
 def _forward_sample_one(
     params, pools, tokens, block_tables, seq_lens, key, cfg,
     temperature, top_k, top_p, min_p, mesh=None,

@@ -42,7 +42,7 @@ import dataclasses
 import time
 import warnings
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,7 @@ import numpy as np
 
 from pretraining_llm_tpu.config import ModelConfig
 from pretraining_llm_tpu.generation import paged, speculative
+from pretraining_llm_tpu.generation import prefix_cache as prefix_cache_mod
 from pretraining_llm_tpu.models import transformer
 from pretraining_llm_tpu.observability import spans as _spans
 
@@ -65,6 +66,9 @@ class _Request:
     # belong to the OUTPUT (see _preempt/_finish).
     prefix: List[int] = dataclasses.field(default_factory=list)
     blocks: List[int] = dataclasses.field(default_factory=list)
+    # Leading entries of ``blocks`` that are SHARED prefix-cache pages
+    # (read-only; refcounted by the cache, never freed directly).
+    n_shared: int = 0
     row: Optional[int] = None
     admit_order: int = -1  # monotonically increasing per admission
     preemptions: int = 0
@@ -132,6 +136,8 @@ class ServingEngine:
         steps_per_sched: int = 1,
         pipeline_depth: int = 2,
         admit_batch: int = 0,
+        prefix_cache: bool = False,
+        prefix_cache_min_blocks: int = 1,
         mesh: Any = None,
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
@@ -323,7 +329,21 @@ class ServingEngine:
             # windows_reaped is the per-window counter bench.py reports).
             "windows": 0, "windows_reaped": 0, "host_blocked_s": 0.0,
             "flushes": 0,
+            # Prompt tokens actually prefilled (suffix-only for cache
+            # hits) — with prefix_cache_hit_tokens this yields the
+            # prefill-reduction ratio bench.py's serving record reports.
+            "prefill_tokens": 0,
         }
+        # Cross-request prefix cache: content-addressed page reuse over
+        # the allocator (generation/prefix_cache.py). Off by default —
+        # when on, greedy outputs stay bit-identical to cache-off runs
+        # (the survivor-identity contract; tests/test_prefix_cache.py).
+        self.prefix_cache: Optional[prefix_cache_mod.PrefixCache] = None
+        if prefix_cache:
+            self.prefix_cache = prefix_cache_mod.PrefixCache(
+                self.alloc, self.block_size,
+                min_blocks=prefix_cache_min_blocks, stats=self.stats,
+            )
 
     # -- public API --------------------------------------------------------
 
@@ -461,6 +481,11 @@ class ServingEngine:
             out["ttft_s"] = t["first_token_s"] - sub
         if "end_s" in t:
             out["e2e_s"] = t["end_s"] - sub
+        if "cached_tokens" in t:
+            # Prompt tokens served from the prefix cache instead of
+            # prefill, summed across admissions (a preemption resume that
+            # re-hits its own published pages counts its savings too).
+            out["cached_tokens"] = int(t["cached_tokens"])
         return out
 
     @property
@@ -915,12 +940,29 @@ class ServingEngine:
 
     # -- scheduling internals ---------------------------------------------
 
+    def _cache_available(self) -> int:
+        """Blocks admission may count on: the free list plus cold cached
+        blocks the LRU would hand back on demand."""
+        avail = self.alloc.available
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.evictable
+        return avail
+
+    def _cache_alloc(self, n: int) -> Optional[List[int]]:
+        """``alloc.alloc(n)``, evicting cold cached blocks first when the
+        free list alone cannot cover the request."""
+        if self.prefix_cache is not None and n > self.alloc.available:
+            self.prefix_cache.evict(n - self.alloc.available)
+        return self.alloc.alloc(n)
+
     def _admission_capacity(self) -> int:
         """How many queue heads could be admitted RIGHT NOW under the
         free-row + watermark rules, without committing anything — the
-        ``admit_batch`` gate's lookahead."""
+        ``admit_batch`` gate's lookahead. (With the prefix cache on this
+        is conservative: cold blocks count as available, but each head is
+        charged its FULL block need, ignoring possible hits.)"""
         free_rows = sum(r is None for r in self.rows)
-        avail = self.alloc.available
+        avail = self._cache_available()
         active = self.n_active
         count = 0
         for req in self.waiting:
@@ -973,58 +1015,133 @@ class ServingEngine:
             p = len(req.prompt)
             # +1: the first decode step writes slot p — its page must exist.
             need = paged.required_blocks(p + 1, self.block_size)
+            # Prefix-cache lookup: retain the longest cached block-aligned
+            # prefix and charge admission only for the uncached remainder.
+            cached_len = 0
+            shared: List[int] = []
+            t_lookup = t_hit = 0.0
+            if self.prefix_cache is not None:
+                t_lookup = time.perf_counter()
+                cached_len, shared = self.prefix_cache.acquire(req.prompt)
+                t_hit = time.perf_counter()
+            need_new = need - len(shared)
             # Admission watermark — where head-of-line admission stalls:
             # keep one growth block of headroom per already-running row,
             # else a nearly-dry pool admits + pays a full prefill only for
             # the newcomer to be preempted at the next older-row block
             # boundary (prefill thrash). The stalled head waits for active
             # rows to finish and free blocks; preemption happens on growth.
-            if self.alloc.available - need < self.n_active:
+            # Cold cached blocks count as available — the LRU hands them
+            # back before any live request is preempted.
+            if self._cache_available() - need_new < self.n_active:
+                if shared:
+                    self.prefix_cache.release_shared(shared)
                 break
-            blocks = self.alloc.alloc(need)
+            blocks = self._cache_alloc(need_new)
             assert blocks is not None, "watermark guarantees coverage"
             self.waiting.popleft()
             row = free_rows[0]
-            req.blocks = blocks
+            req.blocks = shared + blocks
+            req.n_shared = len(shared)
             req.row = row
+            if self.prefix_cache is not None:
+                # Counted only for COMMITTED admissions, so a stalled head
+                # retried at every boundary cannot inflate the hit rate.
+                if cached_len:
+                    self.prefix_cache.note_hit(cached_len)
+                else:
+                    self.prefix_cache.note_miss()
             req.admit_order = self._admit_counter
             self._admit_counter += 1
             self.stats["admissions"] += 1
+            self.stats["prefill_tokens"] += p - cached_len
             t = self.req_timing.get(req.rid)
             if t is not None:
                 # setdefault: a preempted request's re-admission must not
                 # move its queue-wait mark.
                 t.setdefault("admit_s", self._now())
+                if self.prefix_cache is not None:
+                    # Accumulates: a preemption-resume hit on just-published
+                    # pages adds its savings on top of the first admission's.
+                    # Cache off -> key absent, so timing summaries (and the
+                    # JSONL/body schemas built from them) are unchanged.
+                    t["cached_tokens"] = t.get("cached_tokens", 0) + cached_len
             if self.traces:
                 tr = self.traces.get(req.rid)
-                if tr is not None and "admit" not in tr.marks:
-                    # Same setdefault rule: the queue span is submit ->
-                    # FIRST row claim; preemption re-admissions keep it.
-                    now_p = time.perf_counter()
-                    tr.span(
-                        "req.queue", tr.marks.get("submit", tr.t0), now_p,
-                        n_prompt=p,
-                    )
-                    tr.marks["admit"] = now_p
+                if tr is not None:
+                    if self.prefix_cache is not None:
+                        # Recorded only for COMMITTED admissions (stalled
+                        # heads would otherwise stack duplicate spans).
+                        tr.span(
+                            "prefix_cache.lookup", t_lookup, t_hit,
+                            cached_tokens=cached_len, blocks=len(shared),
+                        )
+                    if "admit" not in tr.marks:
+                        # Same setdefault rule: the queue span is submit ->
+                        # FIRST row claim; preemption re-admissions keep it.
+                        now_p = time.perf_counter()
+                        tr.span(
+                            "req.queue", tr.marks.get("submit", tr.t0), now_p,
+                            n_prompt=p,
+                        )
+                        tr.marks["admit"] = now_p
             self.rows[row] = req  # claim now: n_active sees earlier admits
             self.tables[row, :] = 0
-            self.tables[row, : len(blocks)] = blocks
+            self.tables[row, : len(req.blocks)] = req.blocks
             self.seq_lens[row] = p
             admits.append(req)
         if not admits:
             return
-        self._key, sub = jax.random.split(self._key)
-        prompts = [r.prompt for r in admits]
-        prefill_ids = [
-            r.blocks[: paged.required_blocks(len(r.prompt), self.block_size)]
-            for r in admits
-        ]
+        # Cache hits prefill ONLY their uncached suffix (shared pages are
+        # already in the table; PagedInfo seq = cached length), misses run
+        # the full prefill — one batched program per non-empty group.
+        miss = [r for r in admits if r.n_shared == 0]
+        hits = [r for r in admits if r.n_shared > 0]
         t_prefill = time.perf_counter()
-        toks_dev, self.pools = paged.prefill_into_pool_batched(
-            self.params, self.cfg, self.pools, prompts, prefill_ids,
-            sub, temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
-        )
+        groups: List[Tuple[List[_Request], jax.Array]] = []
+        if miss:
+            self._key, sub = jax.random.split(self._key)
+            prompts = [r.prompt for r in miss]
+            prefill_ids = [
+                r.blocks[: paged.required_blocks(len(r.prompt), self.block_size)]
+                for r in miss
+            ]
+            toks_dev, self.pools = paged.prefill_into_pool_batched(
+                self.params, self.cfg, self.pools, prompts, prefill_ids,
+                sub, temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+            )
+            if self.spec_k:
+                # The draft cache must cover the same pages (its sampled
+                # tokens are discarded — the target's first token above is
+                # the round seed either way).
+                _, self.d_pools = paged.prefill_into_pool_batched(
+                    self.draft_params, self.draft_cfg, self.d_pools, prompts,
+                    prefill_ids, sub, temperature=self.temperature,
+                    mesh=self.mesh,
+                )
+            groups.append((miss, toks_dev))
+        if hits:
+            self._key, sub = jax.random.split(self._key)
+            bs = self.block_size
+            suffixes = [r.prompt[r.n_shared * bs:] for r in hits]
+            tables_rows = self.tables[np.asarray([r.row for r in hits])]
+            cached_lens = [r.n_shared * bs for r in hits]
+            toks_dev, self.pools = paged.prefill_suffix_into_pool_batched(
+                self.params, self.cfg, self.pools, suffixes, tables_rows,
+                cached_lens, sub, temperature=self.temperature,
+                top_k=self.top_k, top_p=self.top_p, min_p=self.min_p,
+                mesh=self.mesh,
+            )
+            if self.spec_k:
+                # Shared block ids index BOTH pools, so the draft's prefix
+                # KV is already resident too — suffix-only there as well.
+                _, self.d_pools = paged.prefill_suffix_into_pool_batched(
+                    self.draft_params, self.draft_cfg, self.d_pools,
+                    suffixes, tables_rows, cached_lens, sub,
+                    temperature=self.temperature, mesh=self.mesh,
+                )
+            groups.append((hits, toks_dev))
         if self.traces:
             # Host-side prefill span (dispatch + any compile; the async
             # device compute itself overlaps the next windows). Batched
@@ -1038,32 +1155,26 @@ class ServingEngine:
                         "req.prefill", t_prefill, t_prefill_end,
                         n_prompt=len(req.prompt), batch=len(admits),
                     )
-        if self.spec_k:
-            # The draft cache must cover the same pages (its sampled
-            # tokens are discarded — the target's first token above is
-            # the round seed either way).
-            _, self.d_pools = paged.prefill_into_pool_batched(
-                self.draft_params, self.draft_cfg, self.d_pools, prompts,
-                prefill_ids, sub, temperature=self.temperature,
-                mesh=self.mesh,
-            )
         self.stats["tokens"] += len(admits)  # the prefill-sampled firsts
         if defer:
-            rows = [r.row for r in admits]
-            for i, req in enumerate(admits):
-                req.pending_first = (toks_dev, i)
-            # Next dispatch merges these device scalars into its input
-            # tokens without a host round trip.
-            self._pending_admit_merges.append((toks_dev, list(range(len(admits))), rows))
+            for group, toks_dev in groups:
+                for i, req in enumerate(group):
+                    req.pending_first = (toks_dev, i)
+                # Next dispatch merges these device scalars into its input
+                # tokens without a host round trip.
+                self._pending_admit_merges.append(
+                    (toks_dev, list(range(len(group))), [r.row for r in group])
+                )
             return
-        toks = np.asarray(toks_dev)
-        for i, req in enumerate(admits):
-            tok = int(toks[i])
-            req.generated.append(tok)
-            self._emit_token(req, tok)
-            self.tokens[req.row] = tok
-            if tok == self.stop_token or len(req.generated) >= req.max_new:
-                self._finish(req)
+        for group, toks_dev in groups:
+            toks = np.asarray(toks_dev)
+            for i, req in enumerate(group):
+                tok = int(toks[i])
+                req.generated.append(tok)
+                self._emit_token(req, tok)
+                self.tokens[req.row] = tok
+                if tok == self.stop_token or len(req.generated) >= req.max_new:
+                    self._finish(req)
 
     def _ensure_write_pages(self, horizon: int = 1, prealloc: int = 0) -> None:
         """Every active row's next ``horizon`` write slots must have
@@ -1116,6 +1227,11 @@ class ServingEngine:
                     continue  # retry allocation against the fresh state
                 if self._reclaim_spec_pages(horizon):
                     continue  # speculative grants rolled back; retry
+                if (
+                    self.prefix_cache is not None
+                    and self.prefix_cache.evict(1)
+                ):
+                    continue  # cold cache evicted BEFORE any preemption
                 victim = max(
                     (r for r in self.rows if r is not None),
                     key=lambda r: r.admit_order,
@@ -1229,8 +1345,24 @@ class ServingEngine:
     def _release_row(self, req: _Request) -> None:
         row = req.row
         assert row is not None
-        self.alloc.free(req.blocks)
+        if self.prefix_cache is not None:
+            # Publish the row's committed full blocks back to the cache
+            # (and deref its shared ones). Only slots strictly below
+            # p + g - 1 are guaranteed written — the LAST sampled token
+            # may never have been fed — and any surplus in-flight window
+            # writes at or above that frontier, so publishing below it is
+            # safe even mid-pipeline.
+            g = len(req.generated)
+            p = len(req.prompt)
+            publish_len = p + g - 1 if g else p
+            self.prefix_cache.release_row(
+                req.prompt + req.generated, req.blocks, req.n_shared,
+                publish_len,
+            )
+        else:
+            self.alloc.free(req.blocks)
         req.blocks = []
+        req.n_shared = 0
         req.row = None
         self.rows[row] = None
         self.tables[row, :] = 0

@@ -63,6 +63,47 @@ print(f"serving smoke ok: {st['tokens']} tokens, {st['windows']} windows, "
       f"host_blocked_s={st['host_blocked_s']:.4f}")
 EOF
 
+# Prefix-cache gate: the SAME shared-prefix workload with the cache off
+# and on must produce bit-identical greedy outputs, score real hits, and
+# leave the allocator fully accounted for at drain (idle + cold-cached ==
+# n_blocks - 1; after flush every block is back on the free list).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax, dataclasses
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+rng = np.random.default_rng(3)
+head = rng.integers(0, cfg.vocab_size, size=16).tolist()
+prompts = [head + rng.integers(0, cfg.vocab_size, size=3 + i).tolist()
+           for i in range(6)]
+
+def run(cache):
+    eng = ServingEngine(params, cfg, max_batch=2, n_blocks=24, block_size=8,
+                        temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                        prefix_cache=cache)
+    rids = [eng.submit(p, 8) for p in prompts]
+    out = eng.run(pipeline=True)
+    return [out[r] for r in rids], eng
+
+off, _ = run(False)
+on, eng = run(True)
+assert off == on, "prefix cache changed greedy outputs"
+st = eng.stats
+assert st["prefix_cache_hits"] > 0, st
+assert st["prefix_cache_hit_tokens"] > 0, st
+assert eng.alloc.available + eng.prefix_cache.evictable == 24 - 1, (
+    eng.alloc.available, eng.prefix_cache.evictable)
+eng.prefix_cache.flush()
+assert eng.alloc.available == 24 - 1, eng.alloc.available
+print(f"prefix cache smoke ok: {st['prefix_cache_hits']} hits, "
+      f"{st['prefix_cache_hit_tokens']} cached tokens, "
+      f"{st['prefill_tokens']} prefill tokens")
+EOF
+
 # Gateway gate: the ONLINE path end-to-end over real HTTP. A tiny random-
 # init model behind EngineLoop + ServingGateway serves 4 concurrent
 # requests — one SSE-streaming, one cancelled mid-generation by dropping

@@ -410,6 +410,32 @@ def print_slo_report(report: Dict[str, Any]) -> None:
         print(f"!! {p}")
 
 
+_TERMINAL_EVENTS = ("req_done", "req_cancelled", "req_expired", "req_error")
+
+
+def prefix_cache_summary(
+    events: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Fold terminal request events into the prefix-cache view: hit rate
+    over requests that reached the engine and total prompt tokens served
+    from cache instead of prefill. ``cached_tokens`` only appears in
+    terminal events when the engine ran with the cache on (it accumulates
+    across preemption re-admissions), so absence means no section."""
+    term = [
+        e for e in events
+        if e.get("event") in _TERMINAL_EVENTS and "cached_tokens" in e
+    ]
+    if not term:
+        return None
+    hit = sum(1 for e in term if int(e["cached_tokens"]) > 0)
+    return {
+        "requests": len(term),
+        "hit_requests": hit,
+        "hit_rate": hit / len(term),
+        "prefill_tokens_saved": sum(int(e["cached_tokens"]) for e in term),
+    }
+
+
 def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     events, metrics = split_records(records)
     counts: Dict[str, int] = {}
@@ -425,6 +451,9 @@ def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     }
     if events:
         report["goodput"] = GoodputAccountant.fold(events)
+        pc = prefix_cache_summary(events)
+        if pc is not None:
+            report["prefix_cache"] = pc
     return report
 
 
@@ -459,6 +488,14 @@ def print_report(report: Dict[str, Any]) -> None:
         print("no events")
     for kind, n in report["event_counts"].items():
         print(f"  {kind:<15} {n}")
+    pc = report.get("prefix_cache")
+    if pc:
+        print("== prefix cache ==")
+        print(
+            f"requests={pc['requests']} hit_requests={pc['hit_requests']} "
+            f"hit_rate={pc['hit_rate']:.3f} "
+            f"prefill_tokens_saved={pc['prefill_tokens_saved']}"
+        )
     if report["timeline"]:
         print("== timeline ==")
         for entry in report["timeline"]:

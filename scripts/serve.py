@@ -80,6 +80,14 @@ def main() -> None:
                         help="accumulate waiting prefills until this many "
                         "can be admitted in ONE batched admission (0/1 = "
                         "admit eagerly at every window boundary)")
+    parser.add_argument("--prefix_cache", action="store_true",
+                        help="cross-request prefix cache: finished requests "
+                        "publish their KV blocks; new admissions reuse the "
+                        "longest cached block-aligned prefix and prefill "
+                        "only the suffix (greedy outputs unchanged)")
+    parser.add_argument("--prefix_cache_min_blocks", type=int, default=0,
+                        help="shortest cached prefix (in blocks) worth "
+                        "mapping (0 = config default)")
     parser.add_argument("--tokenizer", default=None,
                         help="override the checkpoint's tokenizer name")
     parser.add_argument("--output", default="",
@@ -152,6 +160,10 @@ def main() -> None:
         steps_per_sched=args.steps_per_sched,
         pipeline_depth=args.pipeline_depth or cfg.serving.pipeline_depth,
         admit_batch=args.admit_batch or cfg.serving.admit_batch,
+        prefix_cache=args.prefix_cache or cfg.serving.prefix_cache,
+        prefix_cache_min_blocks=(
+            args.prefix_cache_min_blocks or cfg.serving.prefix_cache_min_blocks
+        ),
         **spec,
     )
 

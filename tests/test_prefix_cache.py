@@ -1,0 +1,602 @@
+"""Cross-request prefix cache: content-addressed, copy-on-write paged-KV reuse.
+
+The correctness bar (CPU-enforced): greedy serving outputs with the
+prefix cache ON are BIT-IDENTICAL to cache OFF at every pipeline depth,
+through admission churn, cancellation, and preemption. The cache is pure
+host bookkeeping plus a suffix-only prefill — a configuration that
+changed one emitted token would be a shared-page write (CoW violation)
+or a wrong-prefix match, not a perf trade-off.
+
+Unit layer: the content-addressed index / refcount / LRU machinery over
+a real BlockAllocator. Integration layer: ServingEngine identity runs,
+preemption-resume reuse, eviction-before-preemption ordering, allocator
+conservation at drain, and the admission-discount / loadgen satellites.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import ServingConfig, get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, build_schedule
+from pretraining_llm_tpu.generation.generate import generate
+from pretraining_llm_tpu.generation.paged import BlockAllocator
+from pretraining_llm_tpu.generation.prefix_cache import STAT_KEYS, PrefixCache
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+import jax.numpy as jnp
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1, d_model=16, n_heads=2)
+
+DEPTHS = [1, 2, 3]
+BS = 8  # block_size used throughout
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return transformer.init_params(DRAFT_CFG, jax.random.key(99))
+
+
+def _shared_prefix_prompts(n, prefix_blocks=2, tail=(3, 5, 2, 6, 4, 1)):
+    """n prompts sharing a block-aligned common prefix + unique tails."""
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, CFG.vocab_size, size=prefix_blocks * BS).tolist()
+    out = []
+    for i in range(n):
+        t = int(tail[i % len(tail)])
+        out.append(prefix + rng.integers(0, CFG.vocab_size, size=t).tolist())
+    return out
+
+
+def _reference_greedy(params, cfg, prompt, n_new):
+    toks = generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), n_new,
+        jax.random.key(7), temperature=0.0,
+    )
+    return np.asarray(toks)[0].tolist()
+
+
+def _run_cache_pair(params, prompts, n_new, *, depth, cancel_after=None,
+                    **kw):
+    """Run the SAME workload cache-off and cache-on; returns
+    (off_out, on_out, on_eng) with outputs keyed by submission index
+    (committed tokens streamed through on_token, so a cancelled
+    request's partial output is compared too). ``cancel_after`` =
+    (victim_idx, n_tokens): cancel that request once n committed tokens
+    have streamed — issued BETWEEN scheduler turns, the way the online
+    engine loop lands cancellations, identically in both runs."""
+
+    def run(cache):
+        eng = ServingEngine(
+            params, CFG, temperature=0.0, pipeline_depth=depth,
+            prefix_cache=cache, **kw,
+        )
+        rids = [eng.submit(p, n_new) for p in prompts]
+        idx_of = {r: i for i, r in enumerate(rids)}
+        streamed = {i: [] for i in range(len(prompts))}
+        eng.on_token = lambda rid, tok: streamed[idx_of[rid]].append(tok)
+        if cancel_after is None:
+            eng.run(pipeline=True)
+        else:
+            victim_idx, after = cancel_after
+            cancelled = False
+            while eng.has_work() or eng._inflight:
+                eng.pipeline_tick()
+                if not cancelled and sum(map(len, streamed.values())) >= after:
+                    eng.cancel(rids[victim_idx])
+                    cancelled = True
+        return streamed, eng
+
+    off_out, _ = run(False)
+    on_out, eng = run(True)
+    return off_out, on_out, eng
+
+
+# -- unit: content-addressed index / refcounts / LRU ----------------------
+
+
+def _publish(cache, alloc, history, *, n_shared=0, blocks=None):
+    """Allocate blocks for ``history`` and publish its full blocks the
+    way _release_row does for a finished row (g=0: publish_len = len)."""
+    if blocks is None:
+        need = -(-len(history) // cache.block_size)
+        blocks = alloc.alloc(need)
+    cache.release_row(history, blocks, n_shared, len(history))
+    return blocks
+
+
+def test_chain_digest_binds_whole_prefix():
+    """Two identical blocks under DIFFERENT parents must get different
+    digests — block identity encodes the entire prefix, so a flat dict
+    lookup is longest-prefix matching."""
+    block = list(range(8))
+    d_root = PrefixCache._chain(b"", block)
+    d_child = PrefixCache._chain(d_root, block)
+    assert d_root != d_child
+    # And the digest is a pure function of (parent, tokens).
+    assert d_root == PrefixCache._chain(b"", list(range(8)))
+
+
+def test_hit_capped_one_token_short_of_prompt():
+    """A prompt IDENTICAL to a published history may reuse at most
+    (p-1)//bs blocks: the final prompt token always prefills privately
+    (first-token logits need a real forward; the first decode write
+    lands copy-on-write in a private block)."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    hist = list(range(24))  # exactly 3 full blocks
+    _publish(cache, alloc, hist)
+    cached, ids = cache.acquire(hist)
+    assert cached == 16 and len(ids) == 2  # NOT the block containing tok 23
+    cache.release_shared(ids)
+
+
+def test_min_blocks_gates_short_hits():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS, min_blocks=2)
+    _publish(cache, alloc, list(range(24)))
+    # Only 1 block of usable prefix (prompt is 1.5 blocks long) -> miss.
+    assert cache.peek(list(range(12))) == 0
+    # 2 usable blocks -> hit.
+    assert cache.peek(list(range(24))) == 16
+
+
+def test_acquire_refcounts_and_cold_lru_transitions():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    _publish(cache, alloc, list(range(16)))
+    assert cache.evictable == 2 and cache.cached_blocks == 2
+    cached, ids = cache.acquire(list(range(17)))
+    assert cached == 16 and cache.evictable == 0  # retained -> not cold
+    assert cache.evict(5) == 0  # live-shared blocks are never evictable
+    cache.release_shared(ids)
+    assert cache.evictable == 2
+    assert cache.evict(5) == 2  # now they can go
+    assert cache.cached_blocks == 0
+
+
+def test_peek_has_no_side_effects():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    _publish(cache, alloc, list(range(16)))
+    before = (cache.evictable, cache.cached_blocks, alloc.available)
+    assert cache.peek(list(range(17))) == 16
+    assert (cache.evictable, cache.cached_blocks, alloc.available) == before
+
+
+def test_evict_lru_order_touch_refreshes():
+    """Eviction takes the LEAST recently used cold chain first; an
+    acquire/release cycle refreshes recency."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    a = _publish(cache, alloc, [1] * 8)
+    b = _publish(cache, alloc, [2] * 8)
+    # Touch a: it becomes most-recent; b is now the LRU.
+    _, ids = cache.acquire([1] * 9)
+    cache.release_shared(ids)
+    assert cache.evict(1) == 1
+    assert cache.peek([2] * 9) == 0  # b evicted
+    assert cache.peek([1] * 9) == 8  # a survives
+    assert b[0] in alloc._free and a[0] not in alloc._free
+
+
+def test_duplicate_publish_first_writer_wins():
+    """Two rows finishing with the same history: the second publisher's
+    blocks go back to the allocator (content is identical), the index
+    keeps the first — no leak, no double count."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    _publish(cache, alloc, list(range(16)))
+    avail_before = alloc.available
+    _publish(cache, alloc, list(range(16)))  # duplicate content
+    assert cache.cached_blocks == 2
+    assert alloc.available == avail_before  # dup's 2 blocks came right back
+
+
+def test_release_row_frees_partial_tail_and_overgrants():
+    """Only blocks wholly below publish_len are published; the partial
+    tail block and speculative over-grants return to the free list."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    blocks = alloc.alloc(4)  # 2 full + 1 partial + 1 speculative
+    cache.release_row(list(range(20)), blocks, 0, 20)
+    assert cache.cached_blocks == 2
+    assert alloc.available == 16 - 1 - 2  # all but the 2 published are free
+
+
+def test_release_row_publish_len_caps_publication():
+    """publish_len below a block boundary publishes nothing from that
+    block — the engine passes p+g-1 because the last sampled token's
+    K/V may never have been written."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    blocks = alloc.alloc(2)
+    cache.release_row(list(range(16)), blocks, 0, 15)  # last slot unwritten
+    assert cache.cached_blocks == 1  # only the first block is committed
+    assert alloc.available == 16 - 1 - 1
+
+
+def test_release_unreferenced_block_raises():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    with pytest.raises(ValueError, match="unreferenced"):
+        cache.release_shared([3])
+
+
+def test_alloc_upto_cannot_cannibalize_cold_cache():
+    """Cold cached blocks stay in the allocator's _live set — a
+    speculative alloc_upto sweep of the whole pool must not return any
+    block the LRU has not released."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    published = set(_publish(cache, alloc, list(range(16))))
+    got = alloc.alloc_upto(32)  # ask for far more than exists
+    assert not (set(got) & published)
+    alloc.free(got)
+    cache.flush()
+    assert alloc.available == 15  # everything back, nothing lost
+
+
+def test_flush_restores_allocator_exactly():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    _publish(cache, alloc, list(range(24)))
+    _publish(cache, alloc, [5] * 16)
+    assert cache.flush() == cache.stats["prefix_cache_evicted_blocks"]
+    assert cache.cached_blocks == 0 and alloc.available == 15
+
+
+def test_stats_live_in_caller_dict():
+    stats = {"other": 1}
+    alloc = BlockAllocator(4)
+    cache = PrefixCache(alloc, BS, stats=stats)
+    for k in STAT_KEYS:
+        assert stats[k] == 0
+    cache.note_hit(16)
+    cache.note_miss()
+    assert stats["prefix_cache_hits"] == 1
+    assert stats["prefix_cache_hit_tokens"] == 16
+    assert stats["prefix_cache_misses"] == 1
+    assert stats["other"] == 1
+
+
+def test_typed_metrics_bind():
+    from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(prefix="t_")
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    cache.bind(reg)
+    _publish(cache, alloc, list(range(16)))
+    cache.note_hit(8)
+    cache.note_miss()
+    cache.evict(1)
+    text = reg.render()
+    assert "t_prefix_cache_hits_total 1" in text
+    assert "t_prefix_cache_misses_total 1" in text
+    assert "t_prefix_cache_hit_tokens_total 8" in text
+    assert "t_prefix_cache_evicted_blocks_total 1" in text
+    assert "t_prefix_cache_cached_blocks 1" in text
+
+
+# -- integration: greedy bit-identity, cache on vs off --------------------
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_cache_identity_admission_churn(params, depth):
+    """Shared-prefix workload with more requests than rows: later
+    admissions hit pages published by earlier finishes mid-run. Tokens
+    must not move by one bit at any depth, and hits must be real."""
+    prompts = _shared_prefix_prompts(6)
+    n_new = 9
+    off, on, eng = _run_cache_pair(
+        params, prompts, n_new, depth=depth,
+        max_batch=2, n_blocks=32, block_size=BS, steps_per_sched=4,
+    )
+    assert on == off
+    assert eng.stats["prefix_cache_hits"] > 0
+    assert eng.stats["prefix_cache_hit_tokens"] > 0
+    for i, p in enumerate(prompts):
+        assert on[i] == _reference_greedy(params, CFG, p, n_new)
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_cache_identity_under_preemption(params, depth):
+    """Pool too small for both rows' full horizon: preemption +
+    recompute-on-resume with the cache publishing/evicting underneath —
+    outputs exact, and the resume prefill HITS the preempted request's
+    own just-published pages (unique prompts: no other source)."""
+    rng = np.random.default_rng(3)
+    prompts = [
+        rng.integers(0, CFG.vocab_size, size=12).tolist(),
+        rng.integers(0, CFG.vocab_size, size=10).tolist(),
+    ]
+    n_new = 24
+    off, on, eng = _run_cache_pair(
+        params, prompts, n_new, depth=depth,
+        max_batch=2, n_blocks=8, block_size=BS, steps_per_sched=4,
+    )
+    assert on == off
+    assert eng.stats["preemptions"] >= 1
+    for i, p in enumerate(prompts):
+        assert on[i] == _reference_greedy(params, CFG, p, n_new)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_cache_identity_with_cancellation(params, depth):
+    """Mid-run cancellation releases a row whose blocks publish to the
+    cache; survivors and the cancelled request's partial output must be
+    bit-identical to the cache-off run with the same trigger."""
+    prompts = _shared_prefix_prompts(4)
+    n_new = 10
+    off, on, eng = _run_cache_pair(
+        params, prompts, n_new, depth=depth, cancel_after=(1, 5),
+        max_batch=2, n_blocks=32, block_size=BS, steps_per_sched=4,
+    )
+    assert on == off
+    for i, p in enumerate(prompts):
+        if i in on and len(on[i]) == n_new:
+            assert on[i] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_preemption_resume_reuses_published_pages(params):
+    """The preemption-cost win: a preempted request's re-prefill must
+    hit the pages it just published, dropping recompute from full
+    re-prefill to tail-only. Unique prompts mean every cache hit here
+    IS a resume hit."""
+    rng = np.random.default_rng(5)
+    prompts = [
+        rng.integers(0, CFG.vocab_size, size=12).tolist(),
+        rng.integers(0, CFG.vocab_size, size=10).tolist(),
+    ]
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=2, n_blocks=8,
+        block_size=BS, steps_per_sched=4, pipeline_depth=2,
+        prefix_cache=True,
+    )
+    rids = [eng.submit(p, 24) for p in prompts]
+    eng.run(pipeline=True)
+    assert eng.stats["preemptions"] >= 1
+    assert eng.stats["prefix_cache_hits"] >= 1
+    # The resumed request's timing carries the accumulated savings.
+    assert any(
+        eng.timing_summary(r).get("cached_tokens", 0) > 0 for r in rids
+    )
+
+
+def test_eviction_before_preemption(params):
+    """Pool pressure with a cold cache present must evict cache blocks,
+    not preempt live requests: sequential single-row traffic leaves the
+    pool full of cold published pages that later requests' growth must
+    reclaim via the LRU."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, CFG.vocab_size, size=12).tolist()
+               for _ in range(4)]
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=1, n_blocks=10,
+        block_size=BS, steps_per_sched=4, pipeline_depth=2,
+        prefix_cache=True,
+    )
+    for p in prompts:
+        eng.submit(p, 16)
+    eng.run(pipeline=True)
+    assert eng.stats["prefix_cache_evicted_blocks"] >= 1
+    assert eng.stats["preemptions"] == 0
+
+
+def test_allocator_conserved_at_drain_and_flush(params):
+    """Drain invariant with the cache on: free list + cold cache ==
+    whole pool (block 0 aside); a full flush returns every block to the
+    allocator with zero residue."""
+    prompts = _shared_prefix_prompts(5)
+    n_blocks = 32
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=2, n_blocks=n_blocks,
+        block_size=BS, steps_per_sched=4, pipeline_depth=2,
+        prefix_cache=True,
+    )
+    for p in prompts:
+        eng.submit(p, 8)
+    eng.run(pipeline=True)
+    cache = eng.prefix_cache
+    assert eng.alloc.available + cache.evictable == n_blocks - 1
+    assert cache.evictable == cache.cached_blocks  # nothing still shared
+    cache.flush()
+    assert eng.alloc.available == n_blocks - 1
+    assert cache.cached_blocks == 0
+
+
+def test_cached_tokens_in_timing_summary(params):
+    """Per-request cached_tokens must be block-aligned, bounded by the
+    prompt, zero for the cold-start request, and positive for at least
+    one later shared-prefix request."""
+    prompts = _shared_prefix_prompts(4)
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=1, n_blocks=32,
+        block_size=BS, steps_per_sched=4, pipeline_depth=2,
+        prefix_cache=True,
+    )
+    rids = [eng.submit(p, 6) for p in prompts]
+    eng.run(pipeline=True)
+    got = [eng.timing_summary(r).get("cached_tokens", 0) for r in rids]
+    assert got[0] == 0  # cold start
+    assert any(v > 0 for v in got[1:])
+    for v, p in zip(got, prompts):
+        assert v % BS == 0 and v < len(p)
+
+
+def test_min_blocks_engine_gates_hits(params):
+    """min_blocks above the shared-prefix length: no hits, outputs still
+    exact (the gate only changes WHAT is reused, never what is emitted)."""
+    prompts = _shared_prefix_prompts(4, prefix_blocks=1)
+    n_new = 6
+    off, on, eng = _run_cache_pair(
+        params, prompts, n_new, depth=2,
+        max_batch=2, n_blocks=32, block_size=BS, steps_per_sched=4,
+        prefix_cache_min_blocks=2,
+    )
+    assert on == off
+    assert eng.stats["prefix_cache_hits"] == 0
+    assert eng.stats["prefix_cache_misses"] > 0
+
+
+def test_spec_serving_identity_with_cache(params, draft_params):
+    """Speculative serving with the cache on: shared block ids index the
+    draft pool too, so hit admissions suffix-prefill BOTH pools — greedy
+    output must equal the dense-cache reference."""
+    prompts = _shared_prefix_prompts(4)
+    n_new = 8
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=2, n_blocks=32,
+        block_size=BS, draft_params=draft_params, draft_cfg=DRAFT_CFG,
+        spec_k=3, pipeline_depth=2, prefix_cache=True,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run(pipeline=True)
+    assert eng.stats["prefix_cache_hits"] > 0
+    for rid, p in zip(rids, prompts):
+        assert out[rid] == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_admit_batch_with_cache_identity(params):
+    """Cross-window admission batching + cache: a deferred batch mixing
+    hit and miss admissions splits into two prefill programs whose
+    deferred first tokens merge independently — outputs exact."""
+    prompts = _shared_prefix_prompts(6)
+    n_new = 8
+    off, on, eng = _run_cache_pair(
+        params, prompts, n_new, depth=2, admit_batch=2,
+        max_batch=4, n_blocks=48, block_size=BS, steps_per_sched=4,
+    )
+    assert on == off
+    assert eng.stats["prefix_cache_hits"] > 0
+
+
+def test_config_knob_validation(params):
+    with pytest.raises(ValueError, match="prefix_cache_min_blocks"):
+        ServingConfig(prefix_cache_min_blocks=0)
+    with pytest.raises(ValueError, match="min_blocks"):
+        ServingEngine(params, CFG, prefix_cache=True,
+                      prefix_cache_min_blocks=0)
+
+
+# -- satellites: admission discount + hot-prefix loadgen ------------------
+
+
+def test_admission_discount_reduces_outstanding_charge():
+    adm = AdmissionController(max_queue_depth=8, max_outstanding_tokens=100)
+    t1 = adm.try_admit(40, 10, cached_tokens=32)
+    assert adm.outstanding_tokens == 18  # 40 - 32 + 10
+    adm.release(t1)
+    assert adm.outstanding_tokens == 0
+
+
+def test_admission_discount_capped_at_prompt_minus_one():
+    """A stale peek can claim more cached tokens than the prompt has
+    uncached slots; the discount never drops the prompt charge below 1
+    (the privately-prefilled final token)."""
+    adm = AdmissionController(max_queue_depth=8, max_outstanding_tokens=100)
+    t = adm.try_admit(8, 4, cached_tokens=999)
+    assert adm.outstanding_tokens == 1 + 4
+    adm.release(t)
+
+
+def test_admission_discount_buys_headroom():
+    """A request that would bust the token budget fits once its cached
+    prefix is discounted — cache hits buy admission headroom."""
+    adm = AdmissionController(max_queue_depth=8, max_outstanding_tokens=30)
+    from pretraining_llm_tpu.frontend.admission import RejectedBusy
+
+    with pytest.raises(RejectedBusy):
+        adm.try_admit(40, 10)
+    t = adm.try_admit(40, 10, cached_tokens=32)
+    adm.release(t)
+
+
+def test_loadgen_hot_prefix_deterministic_and_shared():
+    spec = LoadSpec(
+        n_requests=40, mode="open", rate_rps=100.0, vocab_size=64,
+        prompt_len_min=2, prompt_len_max=4, max_new_min=1, max_new_max=2,
+        prefix_pool_size=4, prefix_len=16, prefix_zipf=1.5, seed=3,
+    )
+    a = build_schedule(spec)
+    b = build_schedule(spec)
+    assert [r.prompt for r in a] == [r.prompt for r in b]
+    # Every prompt starts with one of exactly pool-size distinct prefixes.
+    heads = {tuple(r.prompt[:16]) for r in a}
+    assert 1 < len(heads) <= 4
+    for r in a:
+        assert 16 + 2 <= len(r.prompt) <= 16 + 4
+
+
+def test_loadgen_zipf_skews_toward_hot_prefix():
+    spec = LoadSpec(
+        n_requests=300, mode="closed", concurrency=1, vocab_size=64,
+        prompt_len_min=1, prompt_len_max=1, max_new_min=1, max_new_max=1,
+        prefix_pool_size=8, prefix_len=8, prefix_zipf=2.0, seed=0,
+    )
+    sched = build_schedule(spec)
+    counts = {}
+    for r in sched:
+        counts[tuple(r.prompt[:8])] = counts.get(tuple(r.prompt[:8]), 0) + 1
+    top = max(counts.values())
+    # zipf s=2 over 8 ranks: rank-1 carries ~62% of the mass.
+    assert top > 0.4 * len(sched)
+
+
+def test_loadgen_pool_off_schedule_unchanged():
+    """prefix_pool_size=0 must consume NO extra rng draws: the schedule
+    is byte-identical to a spec that never heard of prefix pools."""
+    base = LoadSpec(n_requests=10, mode="open", rate_rps=5.0, seed=4)
+    off = LoadSpec(n_requests=10, mode="open", rate_rps=5.0, seed=4,
+                   prefix_pool_size=0, prefix_len=0, prefix_zipf=3.0)
+    assert [r.prompt for r in build_schedule(base)] == \
+        [r.prompt for r in build_schedule(off)]
+
+
+def test_loadgen_prefix_validation():
+    with pytest.raises(ValueError, match="prefix_len"):
+        LoadSpec(prefix_pool_size=2, prefix_len=0)
+    with pytest.raises(ValueError, match="prefix_pool_size"):
+        LoadSpec(prefix_pool_size=-1)
+    with pytest.raises(ValueError, match="prefix_zipf"):
+        LoadSpec(prefix_pool_size=2, prefix_len=4, prefix_zipf=-0.5)
+
+
+def test_engine_loop_surfaces_cached_tokens(params):
+    """End-to-end through the frontend: terminal info (what gateway
+    bodies and req_* events carry) must include cached_tokens, and the
+    registry must expose the typed cache counters."""
+    from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+    from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+
+    registry = MetricsRegistry(prefix="t_")
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=2, n_blocks=32,
+        block_size=BS, steps_per_sched=4, pipeline_depth=2,
+        prefix_cache=True,
+    )
+    adm = AdmissionController(max_queue_depth=8)
+    loop = EngineLoop(eng, admission=adm, registry=registry)
+    prompts = _shared_prefix_prompts(3)
+    with loop:
+        infos = []
+        for p in prompts:
+            status, toks, info = loop.submit(p, 4).result(timeout=120)
+            assert status == "done"
+            infos.append(info)
+    assert infos[0].get("cached_tokens", 0) == 0
+    assert any(i.get("cached_tokens", 0) > 0 for i in infos[1:])
+    text = registry.render()
+    assert "t_prefix_cache_hits_total" in text
+    assert "t_prefix_cache_cached_blocks" in text
