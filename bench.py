@@ -671,6 +671,20 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
             ),
             "cached_tokens_total": s["cached_tokens_total"],
         }
+    # Preemption/rework accounting next to the prefix_cache block: how
+    # much of the run's prefill was recompute-on-resume, and what the
+    # frontend shed on deadline grounds (admission rejects vs. mid-flight
+    # expiries) — the counters the capacity report attributes offline.
+    rec["preemption"] = {
+        "preemptions": eng.stats.get("preemptions", 0),
+        "preempted_tokens_recomputed": eng.stats.get(
+            "preempted_tokens_recomputed", 0
+        ),
+        "deadline_shed": {
+            "admission": admission.stats.get("rejected_infeasible", 0),
+            "inflight": loop.counters.get("expired", 0),
+        },
+    }
     return rec
 
 

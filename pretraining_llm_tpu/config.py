@@ -814,6 +814,10 @@ class FrontendConfig:
     # default, because a cold-start jit compile legitimately holds the
     # loop thread for minutes on slow hosts.
     healthz_stale_after_s: float = 0.0
+    # Capacity observability ring size: per-window occupancy samples and
+    # scheduler decision records kept live for /debug/* (the event-bus
+    # JSONL keeps everything regardless). 0 disables the layer.
+    capacity_ring: int = 512
 
     def __post_init__(self) -> None:
         if not 0 <= self.port <= 65535:
@@ -846,6 +850,11 @@ class FrontendConfig:
             )
         if self.idle_wait_s <= 0:
             raise ValueError(f"idle_wait_s must be > 0, got {self.idle_wait_s}")
+        if self.capacity_ring < 0:
+            raise ValueError(
+                f"capacity_ring must be >= 0 (0 disables), got "
+                f"{self.capacity_ring}"
+            )
 
 
 # ---------------------------------------------------------------------------

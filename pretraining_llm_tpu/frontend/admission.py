@@ -98,6 +98,7 @@ class AdmissionController:
         # the same tallies as `stats`, but as real Prometheus counters
         # with the rejection reason as a label. None = untyped only.
         self._c_admitted = self._c_rejected = None
+        self._g_depth = self._g_tokens = None
         if registry is not None:
             self._c_admitted = registry.counter(
                 "admission_admitted_total", "requests admitted")
@@ -107,6 +108,21 @@ class AdmissionController:
                     "requests rejected at admission", reason=reason)
                 for reason in ("busy", "infeasible")
             }
+            # Live-budget gauges: the numbers snapshot() reports, but as
+            # typed series a scraper can alert on (depth vs. its limit is
+            # the saturation signal capacity attribution keys off).
+            self._g_depth = registry.gauge(
+                "admission_queue_depth", "requests admitted and not terminal")
+            self._g_tokens = registry.gauge(
+                "admission_outstanding_tokens",
+                "sum of prompt+max_new over live requests")
+            registry.gauge(
+                "admission_queue_depth_limit", "max_queue_depth"
+            ).set(self.max_queue_depth)
+            registry.gauge(
+                "admission_outstanding_tokens_limit",
+                "max_outstanding_tokens (0 = unlimited)",
+            ).set(self.max_outstanding_tokens)
 
     # -- queries ------------------------------------------------------------
 
@@ -198,6 +214,9 @@ class AdmissionController:
             raise
         if self._c_admitted is not None:
             self._c_admitted.inc()
+        if self._g_depth is not None:
+            self._g_depth.inc()
+            self._g_tokens.inc(cost)
         return Ticket(cost_tokens=cost)
 
     def release(self, ticket: Ticket, *, tpot_s: Optional[float] = None) -> None:
@@ -209,6 +228,9 @@ class AdmissionController:
             ticket.released = True
             self._live -= 1
             self._outstanding_tokens -= ticket.cost_tokens
+            if self._g_depth is not None:
+                self._g_depth.dec()
+                self._g_tokens.dec(ticket.cost_tokens)
             if tpot_s is not None and tpot_s > 0:
                 if self._tpot_ewma is None:
                     self._tpot_ewma = tpot_s
@@ -216,11 +238,13 @@ class AdmissionController:
                     self._tpot_ewma += self._alpha * (tpot_s - self._tpot_ewma)
 
     def snapshot(self) -> Dict[str, float]:
-        """Counters + live budgets for /metrics."""
+        """Counters + live budgets for /metrics and capacity sampling."""
         with self._lock:
             out: Dict[str, float] = dict(self.stats)
             out["live_requests"] = self._live
             out["outstanding_tokens"] = self._outstanding_tokens
+            out["max_queue_depth"] = self.max_queue_depth
+            out["max_outstanding_tokens"] = self.max_outstanding_tokens
             if self._tpot_ewma is not None:
                 out["tpot_ewma_s"] = self._tpot_ewma
         return out

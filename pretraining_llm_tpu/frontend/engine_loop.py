@@ -37,6 +37,10 @@ from pretraining_llm_tpu.frontend.admission import (
     RejectedInfeasible,
     Ticket,
 )
+from pretraining_llm_tpu.observability.capacity import (
+    CapacitySampler,
+    DecisionLog,
+)
 
 TERMINAL_STATUSES = ("done", "cancelled", "expired", "error")
 
@@ -109,6 +113,7 @@ class EngineLoop:
         clock: Any = time.monotonic,
         tracer: Any = None,
         registry: Any = None,
+        capacity_ring: int = 512,
     ) -> None:
         self.engine = engine
         self.admission = admission
@@ -155,6 +160,44 @@ class EngineLoop:
             cache = getattr(engine, "prefix_cache", None)
             if cache is not None:
                 cache.bind(registry)
+            engine.preempt_counter = registry.counter(
+                "preemptions_total", "running requests preempted (pool dry)")
+            engine.preempt_tokens_counter = registry.counter(
+                "preempted_tokens_recomputed_total",
+                "prompt tokens re-prefilled on preemption resume")
+            self._c_shed = {
+                kind: registry.counter(
+                    "deadline_shed_total",
+                    "requests shed on deadline grounds", kind=kind)
+                for kind in ("admission", "inflight")
+            }
+        else:
+            self._c_shed = {}
+        # Capacity observability (observability/capacity.py): occupancy
+        # sampler + scheduler decision log, installed on the engine like
+        # the histograms above. ``capacity_ring`` bounds both buffers;
+        # 0 disables the layer entirely (engine hooks stay None).
+        if capacity_ring < 0:
+            raise ValueError(
+                f"capacity_ring must be >= 0, got {capacity_ring}"
+            )
+        self.capacity: Optional[CapacitySampler] = None
+        self.decisions: Optional[DecisionLog] = None
+        if capacity_ring > 0:
+            self.capacity = CapacitySampler(
+                engine.max_batch,
+                engine.alloc.n_blocks - 1,  # block 0 is reserved scratch
+                maxlen=capacity_ring,
+                bus=bus,
+                admission_snapshot_fn=(
+                    admission.snapshot if admission is not None else None
+                ),
+            )
+            self.decisions = DecisionLog(maxlen=capacity_ring, bus=bus)
+            if registry is not None:
+                self.capacity.bind(registry)
+            engine.capacity = self.capacity
+            engine.decisions = self.decisions
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         # Engine-loop liveness: monotonic time of the last completed
@@ -314,11 +357,19 @@ class EngineLoop:
         trace_fields: Dict[str, Any],
     ) -> None:
         """Bookkeeping for a request refused before the inbox: one
-        ``req_rejected`` event and a finished (rejected) trace."""
+        ``req_rejected`` event, a decision record, and a finished
+        (rejected) trace."""
         if self.bus is not None:
             self.bus.emit(
                 "req_rejected", reason=reason, detail=detail, **trace_fields
             )
+        if self.decisions is not None and reason in ("busy", "infeasible"):
+            self.decisions.record(
+                f"reject_{reason}", detail=detail,
+                trace_id=trace_fields.get("trace_id"),
+            )
+        if reason == "infeasible" and self._c_shed:
+            self._c_shed["admission"].inc()
         if trace is not None:
             trace.span(
                 "req.admission", time.perf_counter(),
@@ -352,6 +403,102 @@ class EngineLoop:
         if self.admission is not None:
             for k, v in self.admission.snapshot().items():
                 out[f"admission_{k}"] = v
+        return out
+
+    # -- live introspection (gateway threads) --------------------------------
+    #
+    # Both debug views read engine host state WITHOUT the loop thread's
+    # cooperation: every container touched (rows list, waiting deque,
+    # _by_rid dict, req_timing) is only ever mutated between scheduler
+    # turns, and each read is a single snapshot (list()/dict()) of a
+    # structure CPython mutates atomically — so a concurrent turn can make
+    # the view stale by one boundary, never torn mid-request. Purely
+    # host-side: no device access, nothing on the hot path.
+
+    def debug_requests(self) -> List[Dict[str, Any]]:
+        """Per-request live state for /debug/requests: frontend status,
+        engine phase (row vs. queue), blocks held, cached tokens, and the
+        preemption count — the "where is my request right now" view."""
+        eng = self.engine
+        on_row = {}
+        for row, ereq in enumerate(list(eng.rows)):
+            if ereq is not None:
+                on_row[ereq.rid] = (row, ereq)
+        queued = {ereq.rid: ereq for ereq in list(eng.waiting)}
+        now = self._clock()
+        out: List[Dict[str, Any]] = []
+        for rid, req in list(self._by_rid.items()):
+            rec: Dict[str, Any] = {
+                "rid": rid,
+                "status": req.status,
+                "n_prompt": len(req.prompt),
+                "max_new": req.max_new,
+                "n_tokens": len(req.tokens),
+            }
+            if req.trace is not None:
+                rec["trace_id"] = req.trace.trace_id
+            if req.deadline is not None:
+                rec["deadline_remaining_s"] = round(req.deadline - now, 6)
+            ereq = None
+            if rid in on_row:
+                row, ereq = on_row[rid]
+                rec["phase"] = "decode"
+                rec["row"] = row
+            elif rid in queued:
+                ereq = queued[rid]
+                rec["phase"] = "queued"
+            else:
+                rec["phase"] = "inbox"
+            if ereq is not None:
+                rec["blocks_held"] = len(ereq.blocks)
+                rec["blocks_shared"] = ereq.n_shared
+                rec["preemptions"] = ereq.preemptions
+            timing = eng.req_timing.get(rid)
+            if timing and "cached_tokens" in timing:
+                rec["cached_tokens"] = timing["cached_tokens"]
+            out.append(rec)
+        return out
+
+    def debug_engine(self) -> Dict[str, Any]:
+        """Engine-wide capacity state for /debug/engine: pool-block
+        accounting (must tie out against the allocator — the CI gate
+        asserts it), row occupancy, queue depths, the occupancy ring
+        tail, and decision-log totals + tail."""
+        eng = self.engine
+        pool_total = eng.alloc.n_blocks - 1  # block 0 is reserved scratch
+        free = eng.alloc.available
+        cache = getattr(eng, "prefix_cache", None)
+        cold = cache.evictable if cache is not None else 0
+        out: Dict[str, Any] = {
+            "rows": {
+                "active": sum(r is not None for r in list(eng.rows)),
+                "capacity": eng.max_batch,
+            },
+            "waiting": len(eng.waiting),
+            "inbox": self._inbox.qsize(),
+            "pool": {
+                "total": pool_total,
+                "free": free,
+                "cold": cold,
+                "live": pool_total - free - cold,
+            },
+            "stats": {
+                k: v for k, v in list(eng.stats.items())
+                if isinstance(v, (int, float))
+            },
+        }
+        if cache is not None:
+            out["prefix_cache"] = cache.debug_snapshot()
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.capacity is not None:
+            out["occupancy"] = self.capacity.tail(32)
+            out["windows_sampled"] = self.capacity.windows_sampled
+        if self.decisions is not None:
+            out["decisions"] = {
+                "counts": self.decisions.counts_snapshot(),
+                "tail": self.decisions.tail(32),
+            }
         return out
 
     # -- loop thread --------------------------------------------------------
@@ -501,6 +648,17 @@ class EngineLoop:
         if req.trace is not None:
             info["trace_id"] = req.trace.trace_id
         req.info = info
+        if status == "expired":
+            # Deadline shed mid-flight: the decision-log twin of the
+            # admission-time infeasible reject.
+            if self._c_shed:
+                self._c_shed["inflight"].inc()
+            if self.decisions is not None:
+                self.decisions.record(
+                    "expire_inflight", rid=req.rid,
+                    trace_id=info.get("trace_id"),
+                    n_tokens=len(req.tokens),
+                )
         tpot = None
         if (
             status == "done"

@@ -235,6 +235,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             self.gateway.count_response(200)
+        elif self.path.split("?", 1)[0] == "/debug/requests":
+            # Live per-request introspection — best-effort reads off the
+            # hot path (see EngineLoop.debug_requests); stale by at most
+            # one scheduler turn, never torn.
+            self._send_json(200, {"requests": self.gateway.loop.debug_requests()})
+        elif self.path.split("?", 1)[0] == "/debug/engine":
+            self._send_json(200, self.gateway.loop.debug_engine())
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 

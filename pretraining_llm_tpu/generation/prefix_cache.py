@@ -165,6 +165,15 @@ class PrefixCache:
         with self._lock:
             return len(self._hit_blocks(prompt)) * self.block_size
 
+    def debug_snapshot(self) -> Dict[str, int]:
+        """Block accounting for /debug/engine: all indexed blocks, the
+        cold (evictable) subset, and the live-shared remainder — one lock
+        acquisition so the three numbers are mutually consistent."""
+        with self._lock:
+            cached = len(self._index)
+            cold = len(self._cold)
+        return {"cached": cached, "cold": cold, "shared": cached - cold}
+
     # -- admission-side lifecycle ------------------------------------------
 
     def acquire(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:

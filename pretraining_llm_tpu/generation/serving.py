@@ -313,6 +313,16 @@ class ServingEngine:
         # window — never per token.
         self.window_hist: Optional[Any] = None
         self.host_blocked_hist: Optional[Any] = None
+        # Capacity observability (observability/capacity.py), installed by
+        # the frontend like the histograms above: an occupancy sampler fed
+        # once per reaped window (host ints the reap already holds — no
+        # new device syncs), a scheduler decision log fed at the preempt/
+        # evict/reclaim sites, and typed preemption counters. All None by
+        # default; every producer site guards on that.
+        self.capacity: Optional[Any] = None
+        self.decisions: Optional[Any] = None
+        self.preempt_counter: Optional[Any] = None
+        self.preempt_tokens_counter: Optional[Any] = None
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
@@ -821,6 +831,7 @@ class ServingEngine:
             if self.host_blocked_hist is not None:
                 self.host_blocked_hist.observe(blocked)
             capacity = self.max_blocks * self.block_size
+            toks_before = self.stats["tokens"]
             for row, req in w.snapshot:
                 if req.row != row or self.rows[row] is not req:
                     # The row finished in an earlier reap and may have
@@ -869,6 +880,34 @@ class ServingEngine:
                     self._consume_tokens(
                         req, row, window[row], advance_seq=False
                     )
+            if self.capacity is not None:
+                # Occupancy sample AT the reap sync point: every value is
+                # host state this method already touched (row snapshot,
+                # committed-token delta, allocator free count, queue
+                # depth) — no device access, so the asarray-spy contract
+                # holds with sampling enabled.
+                self.capacity.observe_window(
+                    window=widx,
+                    kind=w.kind,
+                    t_dispatch_s=w.t_dispatch or t0,
+                    t_reap_s=t_reaped,
+                    steps=w.n,
+                    rows=len(w.snapshot),
+                    tokens_committed=self.stats["tokens"] - toks_before,
+                    waiting=len(self.waiting),
+                    pool_free=self.alloc.available,
+                    pool_cold=(
+                        self.prefix_cache.evictable
+                        if self.prefix_cache is not None else 0
+                    ),
+                    host_blocked_s=blocked,
+                    cum_tokens=self.stats["tokens"],
+                    cum_prefill_tokens=self.stats["prefill_tokens"],
+                    cum_rework_prefill_tokens=self.stats.get(
+                        "preempted_tokens_recomputed", 0
+                    ),
+                    cum_preemptions=self.stats["preemptions"],
+                )
 
     def _consume_tokens(self, req: _Request, row: int, toks,
                         advance_seq: bool) -> None:
@@ -952,7 +991,11 @@ class ServingEngine:
         """``alloc.alloc(n)``, evicting cold cached blocks first when the
         free list alone cannot cover the request."""
         if self.prefix_cache is not None and n > self.alloc.available:
-            self.prefix_cache.evict(n - self.alloc.available)
+            evicted = self.prefix_cache.evict(n - self.alloc.available)
+            if evicted and self.decisions is not None:
+                self.decisions.record(
+                    "evict_cold", blocks=evicted, reason="admission",
+                )
         return self.alloc.alloc(n)
 
     def _admission_capacity(self) -> int:
@@ -1055,6 +1098,16 @@ class ServingEngine:
             self._admit_counter += 1
             self.stats["admissions"] += 1
             self.stats["prefill_tokens"] += p - cached_len
+            if req.preemptions > 0:
+                # Recompute-on-resume rework, counted where it is actually
+                # PAID: the re-admission's prefill (a cache hit on the
+                # victim's own published pages shrinks it).
+                self.stats["preempted_tokens_recomputed"] = (
+                    self.stats.get("preempted_tokens_recomputed", 0)
+                    + p - cached_len
+                )
+                if self.preempt_tokens_counter is not None:
+                    self.preempt_tokens_counter.inc(p - cached_len)
             t = self.req_timing.get(req.rid)
             if t is not None:
                 # setdefault: a preempted request's re-admission must not
@@ -1231,6 +1284,14 @@ class ServingEngine:
                     self.prefix_cache is not None
                     and self.prefix_cache.evict(1)
                 ):
+                    if self.decisions is not None:
+                        self.decisions.record(
+                            "evict_cold", blocks=1, reason="growth",
+                            rid=req.rid,
+                            trace_id=getattr(
+                                self.traces.get(req.rid), "trace_id", None
+                            ),
+                        )
                     continue  # cold cache evicted BEFORE any preemption
                 victim = max(
                     (r for r in self.rows if r is not None),
@@ -1302,6 +1363,10 @@ class ServingEngine:
             self.stats["page_reclaims"] = (
                 self.stats.get("page_reclaims", 0) + freed
             )
+            if self.decisions is not None:
+                self.decisions.record(
+                    "reclaim_spec", blocks=freed, horizon=horizon,
+                )
         return freed
 
     def _preempt(self, req: _Request) -> None:
@@ -1319,9 +1384,25 @@ class ServingEngine:
             return
         row = req.row
         self.stats["preemptions"] += 1
+        if self.preempt_counter is not None:
+            self.preempt_counter.inc()
         new_prompt = req.prompt + req.generated
         remaining = req.max_new - len(req.generated)
         assert remaining >= 1, "finished requests are reaped, not preempted"
+        if self.decisions is not None:
+            tr = self.traces.get(req.rid)
+            self.decisions.record(
+                "preempt",
+                rid=req.rid,
+                trace_id=getattr(tr, "trace_id", None),
+                row=row,
+                # Why this victim: youngest-first by admission order, so
+                # the oldest admitted requests always make progress.
+                victim_admit_order=req.admit_order,
+                blocks_reclaimed=len(req.blocks),
+                tokens_to_recompute=len(req.generated),
+                preemption_n=req.preemptions + 1,
+            )
         self._release_row(req)
         fresh = _Request(
             req.rid, new_prompt, remaining,

@@ -25,6 +25,12 @@ spent 20% of wall-clock replaying a poison window look identical. The pieces:
   - export.py  — Prometheus textfile exporter (no server dependency): one
                  atomic write per log boundary for a node-exporter-style
                  scrape.
+  - capacity.py — serving capacity accounting: per-window occupancy
+                 samples (rows/tokens/pool/queue at the reap sync point)
+                 and a typed scheduler decision log (reject/shed/preempt/
+                 evict/reclaim), both ring-buffered and bus-emitted;
+                 scripts/obs_report.py --capacity folds them into a
+                 slot-second waterfall naming the binding constraint.
 
 scripts/obs_report.py is the offline half: metrics/events JSONL in, goodput
 breakdown + step-time histogram + event timeline out (run in CI over the
@@ -34,6 +40,11 @@ Everything here is host-side; recording between log boundaries performs no
 device→host syncs (tested). The hub below is what the trainer wires in.
 """
 
+from pretraining_llm_tpu.observability.capacity import (
+    DECISION_KINDS,
+    CapacitySampler,
+    DecisionLog,
+)
 from pretraining_llm_tpu.observability.events import EVENT_KINDS, EventBus, sanitize_record
 from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
 from pretraining_llm_tpu.observability.spans import SpanRecorder, get_recorder, span
@@ -61,6 +72,9 @@ from pretraining_llm_tpu.observability.device import CompileWatcher, DeviceTelem
 from pretraining_llm_tpu.observability.hub import ObservabilityHub
 
 __all__ = [
+    "DECISION_KINDS",
+    "CapacitySampler",
+    "DecisionLog",
     "EVENT_KINDS",
     "EventBus",
     "sanitize_record",

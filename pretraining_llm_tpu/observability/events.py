@@ -57,6 +57,14 @@ EVENT_KINDS = (
     "req_cancelled",  # client cancelled / disconnected (HTTP 499)
     "req_expired",    # deadline passed mid-flight (HTTP 504)
     "req_error",      # engine failure or shutdown (HTTP 500)
+    # Capacity observability (observability/capacity.py). One cap_window
+    # record per reaped decode window (occupancy, pool split, admission
+    # depth; t_dispatch_s/t_reap_s are perf_counter so offline interval
+    # math stays on one clock); one decision record per scheduler action
+    # that costs a request something (decision= one of DECISION_KINDS,
+    # trace_id joins it to the req_* stream).
+    "cap_window",     # per-window occupancy sample: rows, tokens, pool, queue
+    "decision",       # scheduler decision: reject/shed/preempt/evict/reclaim
 )
 
 

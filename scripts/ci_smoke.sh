@@ -290,3 +290,91 @@ python scripts/obs_report.py --strict --slo --slo_e2e_s 60 \
     > "$OBS_TMP/slo_report.out"
 grep -q "traces=8 done=8" "$OBS_TMP/slo_report.out" || {
     echo "obs_report --slo missing the expected 8 traces"; exit 1; }
+
+# Capacity gate: the attribution pipeline under REAL pool pressure. A
+# deliberately tiny pool (2 rows, 7 allocatable blocks) behind the full
+# HTTP stack forces preemptions and cold-cache evictions during a seeded
+# traced loadgen run; /debug/engine's pool accounting must agree with the
+# allocator, and obs_report --capacity --strict must produce a waterfall
+# that sums to wall time within 1% with every decision joined to a known
+# trace — the same contract the unit tests check, proved over the wire.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.observability.spans import SpanRecorder
+from pretraining_llm_tpu.observability.tracing import Tracer
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+# 2 rows over 7 allocatable blocks of 8 tokens: two 10-12 token prompts
+# decoding 20-24 tokens each cannot both fit, so growth MUST preempt and
+# the prefix cache MUST shed cold blocks.
+eng = ServingEngine(params, cfg, max_batch=2, n_blocks=8, block_size=8,
+                    temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                    prefix_cache=True)
+bus = EventBus(os.path.join(tmp, "capacity_events.jsonl"))
+registry = MetricsRegistry("pllm_serving_")
+loop = EngineLoop(eng, admission=AdmissionController(max_queue_depth=8),
+                  bus=bus, tracer=Tracer(SpanRecorder(), sample=1.0, seed=3),
+                  registry=registry)
+gw = ServingGateway(loop, port=0)
+loop.start(); gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+spec = LoadSpec(n_requests=6, mode="closed", concurrency=4, seed=11,
+                vocab_size=cfg.vocab_size, prompt_len_min=10,
+                prompt_len_max=12, max_new_min=20, max_new_max=24,
+                send_traceparent=True)
+# /debug/requests only lists LIVE requests, so poll it while the load
+# runs and keep the richest snapshot we see.
+import threading, time
+live_snap, stop_poll = [], threading.Event()
+def poll():
+    while not stop_poll.is_set():
+        with urllib.request.urlopen(f"{base}/debug/requests", timeout=30) as r:
+            snap = json.loads(r.read())["requests"]
+        if len(snap) > len(live_snap):
+            live_snap[:] = snap
+        time.sleep(0.02)
+poller = threading.Thread(target=poll); poller.start()
+report = run_http(base, spec)
+stop_poll.set(); poller.join(timeout=30)
+assert all(o.status == "done" for o in report.outcomes), report.outcomes
+assert live_snap and all(r["trace_id"] for r in live_snap), live_snap
+assert any(r["phase"] == "decode" and r["row"] is not None
+           for r in live_snap), live_snap
+
+with urllib.request.urlopen(f"{base}/debug/engine", timeout=30) as r:
+    dbg = json.loads(r.read())
+pool = dbg["pool"]
+assert pool["total"] == 8 - 1, pool
+assert pool["free"] + pool["cold"] + pool["live"] == pool["total"], pool
+assert pool["free"] == eng.alloc.available, (pool, eng.alloc.available)
+assert pool["cold"] == eng.prefix_cache.evictable, pool
+assert dbg["stats"]["preemptions"] >= 1, dbg["stats"]
+assert dbg["decisions"]["counts"].get("preempt", 0) >= 1, dbg["decisions"]
+assert dbg["decisions"]["counts"].get("evict_cold", 0) >= 1, dbg["decisions"]
+assert dbg["windows_sampled"] > 0, dbg
+
+gw.stop(); loop.stop(); bus.close()
+print(f"capacity smoke ok: {dbg['stats']['preemptions']} preemptions, "
+      f"{dbg['decisions']['counts']}")
+EOF
+
+# The analyzer must accept the pressured run with --capacity --strict:
+# waterfall segments summing to wall within 1%, every decision joined to
+# a known trace, and a named binding constraint.
+python scripts/obs_report.py --capacity --strict \
+    "$OBS_TMP/capacity_events.jsonl" > "$OBS_TMP/capacity_report.out"
+grep -q "binding constraint:" "$OBS_TMP/capacity_report.out" || {
+    echo "obs_report --capacity missing the binding constraint"; exit 1; }

@@ -26,6 +26,16 @@ segment — "why we missed", not just "that we missed". Under ``--strict``,
 an incomplete span tree (missing root, terminal, or orphaned children) is
 fatal, which is the CI tracing gate.
 
+``--capacity`` adds the ENGINE view over the same events JSONL: the
+``cap_window`` occupancy samples (one per reaped decode window) become a
+slot-second waterfall — productive / admission-starved / pool-starved /
+preempted-rework / spec-wasted, summing to wall time — the run's binding
+constraint is named (slots vs. pool blocks vs. admission budget vs.
+arrival rate), and every scheduler ``decision`` record (preempt, evict,
+shed) is joined to its trace so "why was trace X preempted" is
+answerable offline. ``--strict`` makes a >1% sum error or an unjoinable
+decision fatal, which is the CI capacity gate.
+
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
 """
@@ -436,6 +446,198 @@ def prefix_cache_summary(
     }
 
 
+# -- capacity attribution (--capacity) --------------------------------------
+
+_CAP_SEGMENTS = ("productive", "admission_starved", "pool_starved",
+                 "preempted_rework", "spec_wasted")
+
+
+def build_capacity_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold ``cap_window`` + ``decision`` events into the capacity view:
+    decode-slot-seconds decomposed into segments that sum to wall time,
+    the run's binding constraint, and decision records joined to traces.
+
+    The engine dispatches windows sequentially and reaps them FIFO, so
+    ``cap_window`` records (stamped at reap with perf_counter dispatch/
+    reap times) arrive in dispatch order; under deep pipelining
+    consecutive windows OVERLAP, so each window is charged only its NEW
+    coverage (``d_eff``, the same interval-union idea as ``_union_s``)
+    and the residual gaps are host time between device windows. Within a
+    window's coverage: the active-row share splits into productive
+    (committed tokens) vs. spec-wasted (dispatched slot-tokens never
+    committed — rejected speculative drafts or overrun past stop/
+    max_new); the idle-row share is pool-starved when requests were
+    waiting (rows existed to fill, blocks did not) and admission-starved
+    when the queue was empty. Gaps split by the rework fraction of the
+    prefill they contain (recompute-on-resume re-prefill is pure
+    preemption cost); the remainder follows the same waiting test. Every
+    charge is a disjoint share of [first dispatch, last reap], so the
+    segments sum to wall time by construction — the strict gate checks
+    the arithmetic anyway."""
+    wins = sorted(
+        (e for e in events if e.get("event") == "cap_window"),
+        key=lambda e: (float(e["t_dispatch_s"]), float(e["t_reap_s"])),
+    )
+    decisions = [e for e in events if e.get("event") == "decision"]
+    decision_counts: Dict[str, int] = {}
+    for d in decisions:
+        k = d.get("decision", "?")
+        decision_counts[k] = decision_counts.get(k, 0) + 1
+
+    # Join decisions to the request stream: every trace_id a decision
+    # carries must name a request the req_* events know about.
+    known = {
+        e["trace_id"] for e in events
+        if str(e.get("event", "")).startswith("req_") and e.get("trace_id")
+    }
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    problems: List[str] = []
+    for d in decisions:
+        tid = d.get("trace_id")
+        if not tid:
+            continue
+        by_trace.setdefault(tid, []).append(
+            {k: v for k, v in d.items()
+             if k not in ("event", "seq", "t_wall", "t_mono")}
+        )
+        if tid not in known:
+            problems.append(
+                f"decision {d.get('decision')} carries trace_id "
+                f"{tid[:12]} with no matching req_* event"
+            )
+
+    report: Dict[str, Any] = {
+        "n_windows": len(wins),
+        "decisions": decision_counts,
+        "decisions_by_trace": by_trace,
+        "problems": problems,
+    }
+    if not wins:
+        problems.append("no cap_window events (capacity sampling off?)")
+        return report
+
+    rows_cap = int(wins[0]["rows_capacity"])
+    t0 = min(float(w["t_dispatch_s"]) for w in wins)
+    t1 = max(float(w["t_reap_s"]) for w in wins)
+    wall = t1 - t0
+    segments = dict.fromkeys(_CAP_SEGMENTS, 0.0)
+    slot_bound_s = 0.0
+    admission_saturated = decision_counts.get("reject_busy", 0) > 0
+    cover_end = t0
+    prev_waiting = 0
+    prev_prefill = prev_rework = None
+    for w in wins:
+        t_d, t_r = float(w["t_dispatch_s"]), float(w["t_reap_s"])
+        cum_prefill = int(w.get("cum_prefill_tokens", 0))
+        cum_rework = int(w.get("cum_rework_prefill_tokens", 0))
+        gap = max(0.0, t_d - cover_end)
+        if gap > 0.0:
+            dp = cum_prefill - (prev_prefill or 0)
+            dr = cum_rework - (prev_rework or 0)
+            rework_frac = min(1.0, dr / dp) if dp > 0 else 0.0
+            segments["preempted_rework"] += gap * rework_frac
+            rest = gap * (1.0 - rework_frac)
+            if prev_waiting > 0 or dp > 0:
+                # Host between windows with real work queued (or fresh
+                # prefill landing): scheduling/prefill of useful tokens.
+                segments["productive"] += rest
+            else:
+                segments["admission_starved"] += rest
+        d_eff = max(0.0, t_r - max(t_d, cover_end))
+        cover_end = max(cover_end, t_r)
+        rows = int(w["rows"])
+        slot_tokens = rows * int(w["steps"])
+        committed = min(int(w["tokens_committed"]), slot_tokens)
+        frac = committed / slot_tokens if slot_tokens else 0.0
+        active_s = d_eff * rows / rows_cap if rows_cap else 0.0
+        segments["productive"] += active_s * frac
+        segments["spec_wasted"] += active_s * (1.0 - frac)
+        idle_s = max(0.0, d_eff - active_s)
+        waiting = int(w["waiting"])
+        if waiting > 0:
+            segments["pool_starved"] += idle_s
+            if rows >= rows_cap:
+                slot_bound_s += d_eff
+        else:
+            segments["admission_starved"] += idle_s
+        limit = w.get("admission_depth_limit")
+        if limit and int(w.get("admission_depth", 0)) >= int(limit):
+            admission_saturated = True
+        prev_waiting = waiting
+        prev_prefill, prev_rework = cum_prefill, cum_rework
+
+    total = sum(segments.values())
+    pool_bound_s = segments["pool_starved"] + segments["preempted_rework"]
+    scores = {
+        "slots": slot_bound_s,
+        "pool_blocks": pool_bound_s,
+        "admission_budget":
+            segments["admission_starved"] if admission_saturated else 0.0,
+        "arrival_rate":
+            0.0 if admission_saturated else segments["admission_starved"],
+    }
+    report.update({
+        "rows_capacity": rows_cap,
+        "pool_total": int(wins[0].get("pool_total", 0)),
+        "wall_s": wall,
+        "segments": segments,
+        "sum_error_s": total - wall,
+        "binding_constraint": max(scores, key=lambda k: scores[k]),
+        "constraint_scores": scores,
+    })
+    if wall > 0 and abs(total - wall) > 0.01 * wall:
+        problems.append(
+            f"capacity segments sum to {total:.4f}s but wall is "
+            f"{wall:.4f}s (error {abs(total - wall) / wall:.2%} > 1%)"
+        )
+    return report
+
+
+def print_capacity_report(report: Dict[str, Any]) -> None:
+    print("== capacity ==")
+    if "segments" not in report:
+        print("no cap_window events")
+    else:
+        wall = report["wall_s"]
+        print(
+            f"wall={wall:.3f}s windows={report['n_windows']} "
+            f"rows_capacity={report['rows_capacity']} "
+            f"pool_blocks={report['pool_total']}"
+        )
+        for seg in _CAP_SEGMENTS:
+            sec = report["segments"][seg]
+            pct = 100.0 * sec / wall if wall > 0 else 0.0
+            bar = "#" * int(round(pct / 2))
+            print(f"  {seg:<17} {sec:9.3f}s {pct:5.1f}% {bar}")
+        print(
+            f"sum_error={report['sum_error_s']:+.4f}s  binding constraint: "
+            f"{report['binding_constraint']} (" + " ".join(
+                f"{k}={v:.3f}s"
+                for k, v in report["constraint_scores"].items()
+            ) + ")"
+        )
+    if report["decisions"]:
+        print("== scheduler decisions ==")
+        for kind, n in sorted(report["decisions"].items()):
+            print(f"  {kind:<18} {n}")
+    if report["decisions_by_trace"]:
+        print("== decisions by trace (why was my request shed?) ==")
+        items = sorted(report["decisions_by_trace"].items())
+        for tid, recs in items[:20]:
+            kinds = " ".join(
+                r.get("decision", "?") + (
+                    f"(-{r['blocks_reclaimed']}blk)"
+                    if "blocks_reclaimed" in r else ""
+                )
+                for r in recs
+            )
+            print(f"  {tid[:12]:<12} {kinds}")
+        if len(items) > 20:
+            print(f"  ... {len(items) - 20} more")
+    for p in report["problems"]:
+        print(f"!! {p}")
+
+
 def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     events, metrics = split_records(records)
     counts: Dict[str, int] = {}
@@ -534,9 +736,18 @@ def main() -> int:
         "--slo_e2e_s", type=float, default=0.0,
         help="end-to-end SLO bound in seconds (0 = no bound)",
     )
+    parser.add_argument(
+        "--capacity", action="store_true",
+        help="capacity attribution from cap_window/decision events: "
+        "slot-second waterfall (sums to wall time), binding constraint, "
+        "decision-to-trace join; --strict makes a >1% sum error, an "
+        "unjoinable decision, or a run with no occupancy samples fatal",
+    )
     args = parser.parse_args()
     if args.slo and not args.trace:
         parser.error("--slo needs --trace")
+    if args.capacity and not args.paths:
+        parser.error("--capacity needs events JSONL paths")
     if not args.paths and not args.trace:
         parser.error("nothing to analyze: pass JSONL paths and/or --trace")
 
@@ -555,6 +766,11 @@ def main() -> int:
             trace, slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s
         )
         report["serving"] = slo_report
+    cap_report: Optional[Dict[str, Any]] = None
+    if args.capacity:
+        events, _ = split_records(records)
+        cap_report = build_capacity_report(events)
+        report["capacity"] = cap_report
     if args.json:
         print(json.dumps(report, indent=2, allow_nan=False))
     else:
@@ -562,6 +778,8 @@ def main() -> int:
             print_report(report)
         if slo_report is not None and (args.slo or slo_report["problems"]):
             print_slo_report(slo_report)
+        if cap_report is not None:
+            print_capacity_report(cap_report)
         if bad:
             print(f"!! {bad} unparseable line(s)", file=sys.stderr)
         if slo_report is not None and slo_report["dropped_spans"]:
@@ -575,6 +793,10 @@ def main() -> int:
         return 1
     if args.strict and slo_report is not None and slo_report["problems"]:
         for p in slo_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
+        return 1
+    if args.strict and cap_report is not None and cap_report["problems"]:
+        for p in cap_report["problems"]:
             print(f"STRICT: {p}", file=sys.stderr)
         return 1
     return 0

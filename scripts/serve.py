@@ -248,7 +248,7 @@ def _serve_http(args, cfg, eng, enc) -> None:
     )
     loop = EngineLoop(
         eng, admission=admission, bus=bus, idle_wait_s=fc.idle_wait_s,
-        tracer=tracer, registry=registry,
+        tracer=tracer, registry=registry, capacity_ring=fc.capacity_ring,
     ).start()
     gateway = ServingGateway(
         loop,
@@ -263,7 +263,8 @@ def _serve_http(args, cfg, eng, enc) -> None:
     )
     print(
         f"[serve] gateway listening on http://{gateway._server.server_address[0]}"
-        f":{gateway.port} — POST /v1/generate, GET /healthz, GET /metrics",
+        f":{gateway.port} — POST /v1/generate, GET /healthz, GET /metrics, "
+        f"GET /debug/requests, GET /debug/engine",
         file=sys.stderr,
     )
     # SIGTERM (a plain `kill`, the orchestrator's stop signal) must take

@@ -1,0 +1,471 @@
+"""Capacity observability: occupancy sampling, the scheduler decision
+log, live /debug endpoints, and the offline slot-second waterfall.
+
+The correctness bar mirrors the tracing/metrics layer's: the instruments
+ride EXISTING sync points, so greedy outputs must stay bit-identical with
+the layer on vs. off at every pipeline depth, and an instrumented run
+must pull exactly as many device arrays to host as a plain one (the
+``np.asarray`` spy). On top of that, decision records must JOIN: every
+preemption/eviction carries the trace_id the req_* event stream knows,
+and the capacity waterfall's segments must sum to wall time — the same
+arithmetic the ci_smoke.sh capacity gate enforces over HTTP.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import FrontendConfig, get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_engine_loop
+from pretraining_llm_tpu.generation.generate import generate
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.capacity import (
+    DECISION_KINDS,
+    CapacitySampler,
+    DecisionLog,
+)
+from pretraining_llm_tpu.observability.events import EVENT_KINDS, EventBus
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.observability.spans import SpanRecorder
+from pretraining_llm_tpu.observability.tracing import Tracer
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+
+# The offline analyzer is the CI gate's logic: import it as a module so
+# the waterfall assertions here use EXACTLY what the gate runs.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_capacity", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(12, 10, 11, 12)):
+    rng = np.random.default_rng(7)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)])).tolist()
+        for i in range(n)
+    ]
+
+
+def _reference_greedy(params, prompt, n_new):
+    toks = generate(
+        params, CFG, jnp.asarray([prompt], jnp.int32), n_new,
+        jax.random.key(7), temperature=0.0,
+    )
+    return np.asarray(toks)[0].tolist()
+
+
+def _tiny_pool_engine(params, **kw):
+    """Pool sized so the preemption/eviction ladder actually fires (the
+    test_serving_pipeline preemption-replay sizing, cache on)."""
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_blocks", 8)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("steps_per_sched", 4)
+    kw.setdefault("pipeline_depth", 2)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(params, CFG, temperature=0.0, **kw)
+
+
+# -- unit: the instruments themselves ---------------------------------------
+
+
+def test_decision_log_kinds_and_ring():
+    log = DecisionLog(maxlen=3)
+    with pytest.raises(ValueError, match="unknown decision kind"):
+        log.record("coffee_break")
+    for i in range(5):
+        log.record("preempt", rid=i)
+    assert [r["rid"] for r in log.tail()] == [2, 3, 4]  # ring bounded
+    assert log.counts_snapshot() == {"preempt": 5}  # totals survive eviction
+    assert log.tail(1)[0]["rid"] == 4
+    with pytest.raises(ValueError, match="maxlen"):
+        DecisionLog(maxlen=0)
+
+
+def test_capacity_event_kinds_documented():
+    # The new kinds are part of the documented vocabulary, and every
+    # decision kind is a closed set the analyzer can label.
+    assert "cap_window" in EVENT_KINDS
+    assert "decision" in EVENT_KINDS
+    assert set(DECISION_KINDS) == {
+        "reject_busy", "reject_infeasible", "preempt", "evict_cold",
+        "reclaim_spec", "expire_inflight",
+    }
+
+
+def test_sampler_record_schema_and_bus():
+    events = []
+    bus = EventBus()
+    bus.subscribe(events.append)
+    samp = CapacitySampler(4, 23, maxlen=2, bus=bus)
+    rec = samp.observe_window(
+        window=0, kind="decode", t_dispatch_s=1.0, t_reap_s=1.5, steps=4,
+        rows=3, tokens_committed=10, waiting=1, pool_free=5, pool_cold=2,
+        host_blocked_s=0.1, cum_tokens=10, cum_prefill_tokens=30,
+        cum_rework_prefill_tokens=0, cum_preemptions=0,
+    )
+    assert rec["pool_live"] == 23 - 5 - 2
+    assert rec["slot_tokens"] == 12 and rec["dur_s"] == pytest.approx(0.5)
+    assert events and events[0]["event"] == "cap_window"
+    assert events[0]["rows_capacity"] == 4
+    # JSONL-serializable by the bus's own strict encoder.
+    json.dumps(events[0], allow_nan=False)
+    for i in range(3):
+        samp.observe_window(
+            window=i + 1, kind="decode", t_dispatch_s=2.0 + i,
+            t_reap_s=2.5 + i, steps=4, rows=1, tokens_committed=4,
+            waiting=0, pool_free=7, pool_cold=0, host_blocked_s=0.0,
+            cum_tokens=14 + 4 * i, cum_prefill_tokens=30,
+            cum_rework_prefill_tokens=0, cum_preemptions=0,
+        )
+    assert len(samp.tail()) == 2  # ring bounded
+    assert samp.windows_sampled == 4
+
+
+# -- decision log + trace linkage under real pool pressure ------------------
+
+
+def _pressured_run(params, *, registry=None, events=None):
+    """Seeded loadgen against a tiny-pool engine behind the full frontend
+    (admission + tracing + bus): returns (loop, engine, report)."""
+    eng = _tiny_pool_engine(params)
+    bus = EventBus()
+    if events is not None:
+        bus.subscribe(events.append)
+    tracer = Tracer(SpanRecorder(), sample=1.0, seed=3)
+    admission = AdmissionController(max_queue_depth=8, registry=registry)
+    loop = EngineLoop(
+        eng, admission=admission, bus=bus, tracer=tracer, registry=registry,
+    )
+    spec = LoadSpec(
+        n_requests=4, mode="closed", concurrency=4, seed=11,
+        vocab_size=CFG.vocab_size, prompt_len_min=10, prompt_len_max=12,
+        max_new_min=20, max_new_max=24,
+    )
+    with loop:
+        report = run_engine_loop(loop, spec)
+    return loop, eng, report
+
+
+def test_decision_log_preemption_and_eviction_with_trace_linkage(params):
+    events = []
+    loop, eng, report = _pressured_run(params, events=events)
+    assert all(o.status == "done" for o in report.outcomes)
+    counts = loop.decisions.counts_snapshot()
+    assert counts.get("preempt", 0) >= 1, counts
+    assert counts.get("evict_cold", 0) >= 1, counts
+    assert eng.stats["preemptions"] == counts["preempt"]
+    # Rework accounting: every preemption forces a re-prefill, and the
+    # recomputed-token stat counts what was actually paid.
+    assert eng.stats.get("preempted_tokens_recomputed", 0) >= 1
+    # Linkage: every preempt decision names a trace the req_* stream knows.
+    known = {
+        e["trace_id"] for e in events
+        if e["event"].startswith("req_") and "trace_id" in e
+    }
+    assert len(known) == 4
+    preempts = [r for r in loop.decisions.tail() if r["decision"] == "preempt"]
+    for rec in preempts:
+        assert rec["trace_id"] in known
+        assert rec["blocks_reclaimed"] >= 1
+        assert rec["victim_admit_order"] >= 0
+    # The same records went over the bus as typed `decision` events.
+    bus_decisions = [e for e in events if e["event"] == "decision"]
+    assert len(bus_decisions) == sum(counts.values())
+    # Occupancy sampling rode every reap.
+    caps = [e for e in events if e["event"] == "cap_window"]
+    assert len(caps) == eng.stats["windows_reaped"]
+    assert all(c["rows_capacity"] == 2 and c["pool_total"] == 7 for c in caps)
+
+
+def test_capacity_report_on_pressured_run(params):
+    """The offline fold over the same events the CI gate reads: segments
+    sum to wall within 1%, the binding constraint is named, and every
+    decision joins (problems empty)."""
+    events = []
+    _loop, _eng, _report = _pressured_run(params, events=events)
+    cap = obs_report.build_capacity_report(events)
+    assert cap["problems"] == []
+    assert cap["n_windows"] >= 1
+    wall = cap["wall_s"]
+    total = sum(cap["segments"].values())
+    assert abs(total - wall) <= 0.01 * wall
+    assert cap["binding_constraint"] in cap["constraint_scores"]
+    # A tiny pool with a queue must surface as pool pressure somewhere:
+    # preemption rework or pool-starved idle time exists.
+    assert (
+        cap["segments"]["preempted_rework"] + cap["segments"]["pool_starved"]
+    ) >= 0.0
+    assert cap["decisions"].get("preempt", 0) >= 1
+    assert cap["decisions_by_trace"]  # the "why was trace X" join
+
+
+def test_capacity_report_synthetic_waterfall():
+    """Deterministic arithmetic check: hand-built windows with known
+    overlap, idle rows, uncommitted slots, and a rework gap."""
+    def win(i, t0, t1, rows, steps, committed, waiting, prefill, rework):
+        return {
+            "event": "cap_window", "t_wall": 0.0, "window": i,
+            "t_dispatch_s": t0, "t_reap_s": t1, "steps": steps,
+            "rows": rows, "rows_capacity": 2, "tokens_committed": committed,
+            "waiting": waiting, "pool_free": 1, "pool_cold": 0,
+            "pool_total": 7, "cum_prefill_tokens": prefill,
+            "cum_rework_prefill_tokens": rework, "cum_preemptions": 0,
+        }
+    events = [
+        # Full window, all committed: pure productive.
+        win(0, 0.0, 1.0, 2, 4, 8, 0, 10, 0),
+        # Overlapping window (pipelined): only [1.0, 1.5] is new coverage;
+        # half the rows idle with requests waiting -> pool-starved.
+        win(1, 0.5, 1.5, 1, 4, 4, 1, 10, 0),
+        # Gap [1.5, 2.5] whose prefill was ALL rework -> preempted_rework;
+        # then a window with uncommitted slots -> spec_wasted.
+        win(2, 2.5, 3.0, 2, 4, 4, 0, 20, 10),
+    ]
+    cap = obs_report.build_capacity_report(events)
+    segs = cap["segments"]
+    assert cap["wall_s"] == pytest.approx(3.0)
+    assert sum(segs.values()) == pytest.approx(3.0)
+    # productive: 1.0 (win0) + 0.5*0.5 (win1 active half) + 0.5*0.5 (win2
+    # committed half of its 0.5s full-rows coverage)
+    assert segs["productive"] == pytest.approx(1.0 + 0.25 + 0.25)
+    assert segs["pool_starved"] == pytest.approx(0.25)   # win1 idle half
+    assert segs["preempted_rework"] == pytest.approx(1.0)  # the gap
+    assert segs["spec_wasted"] == pytest.approx(0.25)    # win2 uncommitted
+    assert segs["admission_starved"] == pytest.approx(0.0)
+    assert sum(cap["constraint_scores"].values()) > 0
+
+
+def test_capacity_report_strict_catches_unjoinable_decision():
+    events = [{
+        "event": "decision", "t_wall": 0.0, "decision": "preempt",
+        "trace_id": "feedfacefeedfacefeedfacefeedface", "t_s": 1.0,
+    }]
+    cap = obs_report.build_capacity_report(events)
+    assert any("no matching req_*" in p for p in cap["problems"])
+    assert any("no cap_window" in p for p in cap["problems"])
+
+
+# -- bit-identity and the no-sync guarantee ---------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_outputs_identical_with_capacity_layer(params, depth):
+    """Greedy outputs with the full capacity layer installed (sampler +
+    decision log + bus + registry) are bit-identical to a plain run
+    through the preemption/eviction workload at every depth."""
+    prompts = _prompts(2, lengths=(12, 10))
+    n_new = 24
+
+    def run(instrument):
+        eng = _tiny_pool_engine(params, pipeline_depth=depth)
+        if instrument:
+            reg = MetricsRegistry("pllm_serving_")
+            bus = EventBus()
+            eng.capacity = CapacitySampler(
+                eng.max_batch, eng.alloc.n_blocks - 1, bus=bus,
+            )
+            eng.capacity.bind(reg)
+            eng.decisions = DecisionLog(bus=bus)
+            eng.preempt_counter = reg.counter(
+                "preemptions_total", "preemptions")
+            eng.preempt_tokens_counter = reg.counter(
+                "preempted_tokens_recomputed_total", "rework")
+        for p in prompts:
+            eng.submit(p, n_new)
+        return eng.run(pipeline=True), eng
+
+    out_plain, _ = run(False)
+    out_inst, eng = run(True)
+    assert out_inst == out_plain
+    assert eng.stats["preemptions"] >= 1  # the workload really preempted
+    assert eng.decisions.counts_snapshot().get("preempt", 0) >= 1
+    assert eng.preempt_counter.value == eng.stats["preemptions"]
+    for rid, p in zip(sorted(out_inst), prompts):
+        assert out_inst[rid] == _reference_greedy(params, p, n_new)
+
+
+def test_capacity_sampling_adds_no_device_syncs(params, monkeypatch):
+    """Occupancy sampling + decision logging ride the reap's EXISTING
+    host transfers: instrumented and plain runs must pull the same
+    number of device arrays (np.asarray on a jax.Array is the sync)."""
+    prompts = _prompts(2, lengths=(12, 10))
+
+    def run(instrument):
+        eng = _tiny_pool_engine(params)
+        if instrument:
+            reg = MetricsRegistry("pllm_serving_")
+            eng.capacity = CapacitySampler(
+                eng.max_batch, eng.alloc.n_blocks - 1, bus=EventBus(),
+            )
+            eng.capacity.bind(reg)
+            eng.decisions = DecisionLog(bus=EventBus())
+        for p in prompts:
+            eng.submit(p, 24)
+        real = np.asarray
+        pulls = [0]
+
+        def spy(a, *args, **kw):
+            if isinstance(a, jax.Array):
+                pulls[0] += 1
+            return real(a, *args, **kw)
+
+        monkeypatch.setattr(np, "asarray", spy)
+        try:
+            out = eng.run(pipeline=True)
+        finally:
+            monkeypatch.undo()
+        return out, pulls[0], eng
+
+    out_plain, pulls_plain, _ = run(False)
+    out_inst, pulls_inst, eng = run(True)
+    assert out_inst == out_plain
+    assert pulls_inst == pulls_plain  # zero extra device syncs
+    assert eng.capacity.windows_sampled == eng.stats["windows_reaped"]
+    assert eng.decisions.counts_snapshot().get("preempt", 0) >= 1
+
+
+# -- typed gauges/counters on the registry ----------------------------------
+
+
+def test_admission_gauges_and_preemption_counters(params):
+    reg = MetricsRegistry("pllm_serving_")
+    loop, eng, _report = _pressured_run(params, registry=reg)
+    text = reg.render(extra_gauges=loop.metrics())
+    assert "# TYPE pllm_serving_admission_queue_depth gauge" in text
+    assert "pllm_serving_admission_queue_depth_limit 8.0" in text
+    assert "# TYPE pllm_serving_admission_outstanding_tokens gauge" in text
+    assert "# TYPE pllm_serving_preemptions_total counter" in text
+    assert "# TYPE pllm_serving_preempted_tokens_recomputed_total counter" in text
+    assert 'pllm_serving_deadline_shed_total{kind="admission"} 0.0' in text
+    assert "# TYPE pllm_serving_capacity_rows_active gauge" in text
+    assert 'pllm_serving_capacity_pool_blocks{state="free"}' in text
+    assert "pllm_serving_capacity_pool_blocks_limit 7.0" in text
+    assert "# TYPE pllm_serving_capacity_window_occupancy histogram" in text
+    # The typed preemption counter agrees with the engine stat, and the
+    # admission gauges drained back to zero at run end.
+    assert eng.preempt_counter.value == eng.stats["preemptions"] >= 1
+    m = loop.metrics()
+    assert m["admission_live_requests"] == 0
+    assert m["admission_outstanding_tokens"] == 0
+
+
+def test_frontend_config_capacity_ring_validation():
+    assert FrontendConfig().capacity_ring == 512
+    with pytest.raises(ValueError, match="capacity_ring"):
+        FrontendConfig(capacity_ring=-1)
+
+
+def test_engine_loop_capacity_ring_zero_disables(params):
+    eng = _tiny_pool_engine(params)
+    loop = EngineLoop(eng, capacity_ring=0)
+    assert loop.capacity is None and loop.decisions is None
+    assert eng.capacity is None and eng.decisions is None
+    with pytest.raises(ValueError, match="capacity_ring"):
+        EngineLoop(eng, capacity_ring=-1)
+
+
+# -- /debug endpoints --------------------------------------------------------
+
+
+def test_debug_endpoints_pool_accounting(params):
+    eng = _tiny_pool_engine(params)
+    admission = AdmissionController(max_queue_depth=8)
+    loop = EngineLoop(eng, admission=admission)
+    gw = ServingGateway(loop, port=0)
+    loop.start()
+    gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/debug/engine", timeout=30) as r:
+            dbg = json.loads(r.read())
+        pool = dbg["pool"]
+        # The gate's invariant: the debug view's block accounting ties
+        # out against the allocator exactly.
+        assert pool["total"] == eng.alloc.n_blocks - 1 == 7
+        assert pool["free"] == eng.alloc.available
+        assert pool["free"] + pool["cold"] + pool["live"] == pool["total"]
+        assert dbg["rows"] == {"active": 0, "capacity": 2}
+        assert dbg["admission"]["max_queue_depth"] == 8
+        assert dbg["decisions"]["counts"] == {}
+        with urllib.request.urlopen(f"{base}/debug/requests", timeout=30) as r:
+            assert json.loads(r.read())["requests"] == []
+        # Now run pressure through the HTTP-adjacent loop and re-read.
+        spec = LoadSpec(
+            n_requests=4, mode="closed", concurrency=4, seed=11,
+            vocab_size=CFG.vocab_size, prompt_len_min=10, prompt_len_max=12,
+            max_new_min=20, max_new_max=24,
+        )
+        run_engine_loop(loop, spec)
+        with urllib.request.urlopen(
+            f"{base}/debug/engine?tail=8", timeout=30
+        ) as r:
+            dbg = json.loads(r.read())
+        assert dbg["decisions"]["counts"].get("preempt", 0) >= 1
+        assert dbg["occupancy"], "occupancy ring tail missing"
+        last = dbg["occupancy"][-1]
+        assert last["rows_capacity"] == 2 and last["pool_total"] == 7
+        assert dbg["windows_sampled"] == eng.stats["windows_reaped"]
+        pool = dbg["pool"]
+        assert pool["free"] + pool["cold"] + pool["live"] == pool["total"]
+        assert pool["free"] == eng.alloc.available
+        assert dbg["prefix_cache"]["cold"] == eng.prefix_cache.evictable
+    finally:
+        gw.stop()
+        loop.stop()
+
+
+def test_debug_requests_live_state(params):
+    """Mid-decode, /debug/requests shows phase/row/blocks for an active
+    request. Throttle the tick so 'mid-generation' is reliably observable
+    (the test_frontend idiom)."""
+    import time as _time
+
+    eng = _tiny_pool_engine(params)
+    orig = eng.pipeline_tick
+
+    def slow_tick():
+        _time.sleep(0.05)
+        return orig()
+
+    eng.pipeline_tick = slow_tick
+    loop = EngineLoop(eng)
+    loop.start()
+    try:
+        h = loop.submit(_prompts(1)[0], 32, deadline_s=300.0)
+        deadline = _time.monotonic() + 30.0
+        seen = None
+        while _time.monotonic() < deadline:
+            recs = loop.debug_requests()
+            active = [r for r in recs if r.get("phase") == "decode"]
+            if active:
+                seen = active[0]
+                break
+            _time.sleep(0.01)
+        assert seen is not None, "request never observed on a row"
+        assert seen["row"] in (0, 1)
+        assert seen["blocks_held"] >= 1
+        assert seen["status"] == "active"
+        assert seen["deadline_remaining_s"] > 0
+        h.result(timeout=300)
+    finally:
+        loop.stop()
