@@ -64,7 +64,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
         "--mode", default="train", choices=["train", "decode", "trainer",
-                                            "serving", "serving-slo"],
+                                            "serving", "serving-slo",
+                                            "serving-fleet"],
         help="train: tokens/sec + MFU of the train step (the driver metric); "
         "decode: KV-cached generation tokens/sec; trainer: the FULL Trainer "
         "loop incl. the input pipeline (measures host-sampling overlap — "
@@ -72,7 +73,10 @@ def parse_args(argv=None) -> argparse.Namespace:
         "engine throughput (mixed-length requests through a fixed row set); "
         "serving-slo: ONLINE latency under Poisson load through the "
         "frontend EngineLoop — p50/p99 TTFT and goodput-under-SLO, not "
-        "offline throughput",
+        "offline throughput; serving-fleet: the same Poisson load through "
+        "the N-replica fleet Router while a --fleet-scenario disturbance "
+        "runs (replica kill mid-burst, rolling restart, skewed hot-prefix "
+        "affinity) — measures goodput and redrive cost under failure",
     )
     parser.add_argument(
         "--steps-per-sched", type=int, default=0,
@@ -231,6 +235,20 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--prefix-zipf", type=float, default=1.0,
         help="serving-slo mode: zipf skew over prefix-pool rank "
         "(0 = uniform, larger = hotter head)",
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=2,
+        help="serving-fleet mode: in-process engine replicas behind the "
+        "router",
+    )
+    parser.add_argument(
+        "--fleet-scenario", default="kill",
+        choices=["kill", "rolling", "hotprefix"],
+        help="serving-fleet mode: kill = deterministic replica_crash on "
+        "replica 0 one third into the burst (redrive drill); rolling = "
+        "drain/restore each replica in turn under load; hotprefix = "
+        "zipf-skewed shared-prefix traffic, measuring prefix-affinity "
+        "placement (per-replica spread, no faults)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -688,6 +706,189 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
     return rec
 
 
+def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
+    """Online latency under load through the N-replica fleet Router while
+    a scenario disturbance runs: 'kill' crashes replica 0 mid-burst (the
+    router ejects it, redrives its in-flight requests to survivors and
+    relaunches it), 'rolling' drains/restores every replica in turn, and
+    'hotprefix' sends zipf-skewed shared-prefix traffic to measure
+    prefix-affinity placement. Reports goodput plus the fleet-only
+    numbers: redrive count/cost, ejects, per-replica request spread."""
+    import jax
+
+    from pretraining_llm_tpu.config import get_preset
+    from pretraining_llm_tpu.frontend.admission import AdmissionController
+    from pretraining_llm_tpu.frontend.loadgen import (
+        LoadSpec, rolling_restart_plan, run_engine_loop, run_fleet_plan,
+    )
+    from pretraining_llm_tpu.frontend.replica import Replica
+    from pretraining_llm_tpu.frontend.router import Router
+    from pretraining_llm_tpu.generation.generate import decode_bench_workload
+    from pretraining_llm_tpu.generation.serving import ServingEngine
+    from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+    noop = {
+        "--attention": args.attention, "--remat": args.remat, "--ce": args.ce,
+        "--optimizer": args.optimizer, "--unroll": args.unroll,
+        "--block-q": args.block_q, "--block-kv": args.block_kv,
+        "--ragged": args.ragged, "--decode-unroll": args.decode_unroll,
+        "--context": args.context, "--grad-dtype": args.grad_dtype,
+        "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
+    }
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(
+            f"{', '.join(bad)} have no effect on the serving-fleet path"
+        )
+    if args.replicas < 2:
+        raise ValueError("serving-fleet mode needs --replicas >= 2")
+
+    cfg = get_preset(args.preset).model
+    if args.kv_dtype:
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+    if args.paged_attn:
+        cfg = dataclasses.replace(cfg, paged_attention_impl=args.paged_attn)
+    if args.cache_layout:
+        cfg = dataclasses.replace(cfg, decode_cache_layout=args.cache_layout)
+    max_batch = args.batch or 4  # per replica; the fleet multiplies it
+    if args.quick:
+        max_batch = min(max_batch, 4)
+    cfg, params, canon_prompt, new_tokens = decode_bench_workload(
+        cfg, max_batch, quick=args.quick
+    )
+    prompt_len = int(canon_prompt.shape[1])
+    block_size = min(64, cfg.context_length)
+    n_requests = args.n_requests or 4 * max_batch * args.replicas
+    pfx_pool = args.prefix_pool_size
+    pfx_len = 0
+    if args.fleet_scenario == "hotprefix":
+        pfx_pool = pfx_pool or 2 * args.replicas
+        block_size = min(block_size, max(8, cfg.context_length // 8))
+        pfx_len = args.prefix_len or 2 * block_size
+        room = cfg.context_length - new_tokens - pfx_len
+        if room < 1:
+            raise ValueError(
+                f"--prefix-len {pfx_len} leaves no room for prompts "
+                f"(context {cfg.context_length}, new_tokens {new_tokens})"
+            )
+        prompt_len = min(prompt_len, room)
+    pages_per_req = -(-(pfx_len + prompt_len + new_tokens) // block_size)
+    n_blocks = max_batch * pages_per_req + max_batch + 1
+    sps = args.steps_per_sched or 8
+    depth = args.pipeline_depth or 2
+
+    def make_engine():
+        return ServingEngine(
+            params, cfg, max_batch=max_batch, n_blocks=n_blocks,
+            block_size=block_size, temperature=0.0,
+            steps_per_sched=sps, pipeline_depth=depth,
+            admit_batch=args.admit_batch,
+            prefix_cache=args.prefix_cache,
+        )
+
+    faults = None
+    if args.fleet_scenario == "kill":
+        # Crash replica 0 when it accepts its (n/3)th request — mid-burst
+        # by construction, deterministic under the seeded schedule.
+        kill_at = max(2, n_requests // (3 * args.replicas))
+        faults = ServingFaultInjector(f"replica_crash@req{kill_at}:r0")
+
+    replicas = [
+        Replica(
+            i, make_engine, fault_injector=faults,
+            admission_factory=lambda reg: AdmissionController(
+                max_queue_depth=4 * max_batch, registry=reg
+            ),
+        )
+        for i in range(args.replicas)
+    ]
+    router = Router(
+        replicas,
+        admission=AdmissionController(
+            max_queue_depth=4 * max_batch * args.replicas
+        ),
+        eject_backoff_s=0.2,
+    )
+    spec = LoadSpec(
+        n_requests=n_requests, mode="open", rate_rps=args.rate_rps,
+        vocab_size=cfg.vocab_size,
+        prompt_len_min=max(1, prompt_len // 4), prompt_len_max=prompt_len,
+        max_new_min=new_tokens, max_new_max=new_tokens,
+        slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s, seed=0,
+        prefix_pool_size=pfx_pool, prefix_len=pfx_len,
+        prefix_zipf=args.prefix_zipf,
+    )
+    router.start()
+    try:
+        # Warm each replica's compiled programs outside the measured window.
+        warm = [
+            rep.submit([1] * prompt_len, new_tokens) for rep in replicas
+        ]
+        for w in warm:
+            w.result()
+        plan_th = None
+        if args.fleet_scenario == "rolling":
+            est_wall = n_requests / args.rate_rps
+            plan_th = run_fleet_plan(
+                router,
+                rolling_restart_plan(
+                    args.replicas,
+                    start_s=0.25 * est_wall,
+                    step_s=max(0.5, 0.5 * est_wall / args.replicas),
+                ),
+            )
+        report = run_engine_loop(router, spec)
+        if plan_th is not None:
+            plan_th.join(timeout=60.0)
+        per_replica = {rep.index: rep.submits for rep in replicas}
+        counters = dict(router.counters)
+    finally:
+        router.stop()
+    s = report.summary()
+    # Zero-lost invariant: every scheduled request must come back with SOME
+    # terminal outcome (done/expired/rejected/error), disturbance or not.
+    lost = spec.n_requests - len(report.outcomes)
+    rec = {
+        "metric": f"serving_fleet_{args.fleet_scenario}_{args.preset}",
+        "value": round(s["goodput_rps"], 3),
+        "unit": "slo_ok_requests_per_sec",
+        "vs_baseline": None,  # the reference has no serving stack
+        "scenario": args.fleet_scenario,
+        "replicas": args.replicas,
+        "slo_attainment": round(s["slo_attainment"], 4),
+        "counts": s["counts"],
+        "n_requests": n_requests,
+        "rate_rps": args.rate_rps,
+        "redrives_total": s["redrives_total"],
+        "router": {
+            "redrives": counters.get("redrives", 0),
+            "ejects": counters.get("ejects", 0),
+            "brownout_shed": counters.get("brownout_shed", 0),
+            "errors": counters.get("errors", 0),
+        },
+        "per_replica_submits": per_replica,
+        "lost_requests": lost,
+        "ttft_p50_s": round(s["ttft"]["p50"], 4),
+        "ttft_p99_s": round(s["ttft"]["p99"], 4),
+        "e2e_p50_s": round(s["e2e"]["p50"], 4),
+        "e2e_p99_s": round(s["e2e"]["p99"], 4),
+        "throughput_tok_s": round(s["throughput_tok_s"], 1),
+        "max_batch_per_replica": max_batch,
+        "new_tokens_per_request": new_tokens,
+        "steps_per_sched": sps,
+        "pipeline_depth": depth,
+        "block_size": block_size,
+        "n_blocks": n_blocks,
+        "wall_s": round(report.wall_s, 2),
+        "device": jax.devices()[0].device_kind,
+    }
+    if args.fleet_scenario == "hotprefix":
+        rec["prefix_pool_size"] = pfx_pool
+        rec["prefix_len"] = pfx_len
+        rec["prefix_zipf"] = args.prefix_zipf
+    return rec
+
+
 def run_trainer_bench(args: argparse.Namespace) -> dict:
     """Tokens/sec of the FULL Trainer loop (synthetic data): step dispatch +
     host sampling + H2D, i.e. what the train CLI actually sustains. The
@@ -808,6 +1009,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         return run_serving_bench(args)
     if args.mode == "serving-slo":
         return run_serving_slo_bench(args)
+    if args.mode == "serving-fleet":
+        return run_serving_fleet_bench(args)
 
     # Decode-only knobs are REJECTED on the train path (mirror of the
     # decode-mode noop guard): a silently-ignored flag would emit a record

@@ -818,6 +818,40 @@ class FrontendConfig:
     # scheduler decision records kept live for /debug/* (the event-bus
     # JSONL keeps everything regardless). 0 disables the layer.
     capacity_ring: int = 512
+    # ---- fleet (frontend/router.py); replicas=1 keeps the single
+    # EngineLoop path with zero router overhead. -----------------------
+    # Number of in-process engine replicas behind the router tier.
+    replicas: int = 1
+    # Prefix-affinity routing: prompt tokens hashed for placement. 0
+    # disables affinity (pure least-loaded).
+    affinity_tokens: int = 32
+    # Spill off the affinity choice when it carries this many more
+    # in-system requests than the least-loaded replica.
+    spill_margin: int = 4
+    # Watchdog: eject a replica whose loop has active requests but has
+    # not completed a scheduler turn for this long. 0 disables (same
+    # cold-jit rationale as healthz_stale_after_s).
+    wedged_after_s: float = 0.0
+    # Relaunch backoff for ejected replicas: initial and cap (doubles).
+    eject_backoff_s: float = 0.5
+    eject_backoff_max_s: float = 8.0
+    # Max failovers per request before it errors out.
+    redrive_max: int = 3
+    # Brownout: when the healthy fraction of the fleet drops below this,
+    # shed low-priority / long-deadline work with 429. 0 disables.
+    brownout_min_healthy_frac: float = 0.0
+    # Under brownout: shed requests with priority below this ...
+    brownout_min_priority: int = 1
+    # ... or deadline longer than this (0 = don't shed on deadline).
+    brownout_max_deadline_s: float = 0.0
+    # Serving-path fault plan, e.g. "replica_crash@req3:r0,slow_window@req5"
+    # ("" = none). See resilience.faults.parse_serving_faults.
+    serving_faults: str = ""
+    # Retry-After jitter: 429/503 headers carry base * U[1, 1+frac],
+    # drawn from a PRNG seeded with retry_jitter_seed (deterministic for
+    # tests; decorrelates client retry herds in prod).
+    retry_jitter_frac: float = 0.25
+    retry_jitter_seed: int = 0
 
     def __post_init__(self) -> None:
         if not 0 <= self.port <= 65535:
@@ -854,6 +888,48 @@ class FrontendConfig:
             raise ValueError(
                 f"capacity_ring must be >= 0 (0 disables), got "
                 f"{self.capacity_ring}"
+            )
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.affinity_tokens < 0:
+            raise ValueError(
+                f"affinity_tokens must be >= 0, got {self.affinity_tokens}"
+            )
+        if self.spill_margin < 1:
+            raise ValueError(
+                f"spill_margin must be >= 1, got {self.spill_margin}"
+            )
+        if self.wedged_after_s < 0:
+            raise ValueError(
+                f"wedged_after_s must be >= 0, got {self.wedged_after_s}"
+            )
+        if self.eject_backoff_s <= 0:
+            raise ValueError(
+                f"eject_backoff_s must be > 0, got {self.eject_backoff_s}"
+            )
+        if self.eject_backoff_max_s < self.eject_backoff_s:
+            raise ValueError(
+                "eject_backoff_max_s must be >= eject_backoff_s, got "
+                f"{self.eject_backoff_max_s} < {self.eject_backoff_s}"
+            )
+        if self.redrive_max < 0:
+            raise ValueError(
+                f"redrive_max must be >= 0, got {self.redrive_max}"
+            )
+        if not 0.0 <= self.brownout_min_healthy_frac <= 1.0:
+            raise ValueError(
+                "brownout_min_healthy_frac must be in [0, 1], got "
+                f"{self.brownout_min_healthy_frac}"
+            )
+        if self.brownout_max_deadline_s < 0:
+            raise ValueError(
+                "brownout_max_deadline_s must be >= 0, got "
+                f"{self.brownout_max_deadline_s}"
+            )
+        if not 0.0 <= self.retry_jitter_frac <= 1.0:
+            raise ValueError(
+                "retry_jitter_frac must be in [0, 1], got "
+                f"{self.retry_jitter_frac}"
             )
 
 

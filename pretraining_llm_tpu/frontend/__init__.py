@@ -15,10 +15,15 @@ load. This package adds the online half:
                  time (-> 504);
   gateway      — a stdlib ThreadingHTTPServer exposing POST /v1/generate
                  (JSON in; full response or SSE token streaming out),
-                 GET /healthz and GET /metrics (Prometheus text via the
-                 observability exporter);
+                 GET /healthz, GET /readyz and GET /metrics (Prometheus
+                 text via the observability exporter);
   loadgen      — open-loop (Poisson) and closed-loop load generators
-                 reporting TTFT/TPOT/e2e percentiles and goodput-under-SLO.
+                 reporting TTFT/TPOT/e2e percentiles and goodput-under-SLO;
+  replica      — one restartable engine replica (engine factory +
+                 EngineLoop + per-replica registry/admission/fault clock);
+  router       — the fleet tier over N replicas: prefix-affinity routing
+                 with spill, health-based ejection with backoff, brownout
+                 shedding, and drain/redrive of in-flight requests.
 
 Everything is CPU-testable with the tiny preset; the reference has no
 serving stack at all (batch-1 fixed-count generate).
@@ -35,9 +40,20 @@ from pretraining_llm_tpu.frontend.engine_loop import (  # noqa: F401
 )
 from pretraining_llm_tpu.frontend.gateway import ServingGateway  # noqa: F401
 from pretraining_llm_tpu.frontend.loadgen import (  # noqa: F401
+    FleetAction,
     LoadReport,
     LoadSpec,
     build_schedule,
+    rolling_restart_plan,
     run_engine_loop,
+    run_fleet_plan,
     run_http,
+)
+from pretraining_llm_tpu.frontend.replica import (  # noqa: F401
+    Replica,
+    ReplicaUnavailable,
+)
+from pretraining_llm_tpu.frontend.router import (  # noqa: F401
+    Router,
+    RouterRequest,
 )

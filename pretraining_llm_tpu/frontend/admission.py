@@ -68,6 +68,7 @@ class AdmissionController:
         shed_infeasible: bool = True,
         tpot_ewma_alpha: float = 0.2,
         registry: Optional[object] = None,
+        scope: str = "",
     ) -> None:
         if max_queue_depth < 1:
             raise ValueError(
@@ -99,29 +100,38 @@ class AdmissionController:
         # with the rejection reason as a label. None = untyped only.
         self._c_admitted = self._c_rejected = None
         self._g_depth = self._g_tokens = None
+        # ``scope`` distinguishes multiple controllers on ONE registry
+        # (the fleet router's budget vs. each replica's own): it becomes a
+        # label on every typed series here, so the names stay shared while
+        # the samples stay apart. "" = no label (the single-engine case,
+        # and replicas whose registries already carry a const replica
+        # label).
+        sl = {"scope": scope} if scope else {}
+        self.scope = scope
         if registry is not None:
             self._c_admitted = registry.counter(
-                "admission_admitted_total", "requests admitted")
+                "admission_admitted_total", "requests admitted", **sl)
             self._c_rejected = {
                 reason: registry.counter(
                     "admission_rejected_total",
-                    "requests rejected at admission", reason=reason)
+                    "requests rejected at admission", reason=reason, **sl)
                 for reason in ("busy", "infeasible")
             }
             # Live-budget gauges: the numbers snapshot() reports, but as
             # typed series a scraper can alert on (depth vs. its limit is
             # the saturation signal capacity attribution keys off).
             self._g_depth = registry.gauge(
-                "admission_queue_depth", "requests admitted and not terminal")
+                "admission_queue_depth",
+                "requests admitted and not terminal", **sl)
             self._g_tokens = registry.gauge(
                 "admission_outstanding_tokens",
-                "sum of prompt+max_new over live requests")
+                "sum of prompt+max_new over live requests", **sl)
             registry.gauge(
-                "admission_queue_depth_limit", "max_queue_depth"
+                "admission_queue_depth_limit", "max_queue_depth", **sl
             ).set(self.max_queue_depth)
             registry.gauge(
                 "admission_outstanding_tokens_limit",
-                "max_outstanding_tokens (0 = unlimited)",
+                "max_outstanding_tokens (0 = unlimited)", **sl
             ).set(self.max_outstanding_tokens)
 
     # -- queries ------------------------------------------------------------

@@ -29,6 +29,7 @@ import dataclasses
 import queue
 import threading
 import time
+import warnings
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from pretraining_llm_tpu.frontend.admission import (
@@ -69,6 +70,9 @@ class FrontendRequest:
     tokens: List[int] = dataclasses.field(default_factory=list)
     info: Dict[str, Any] = dataclasses.field(default_factory=dict)
     cancel_requested: bool = False
+    # Scheduling priority (higher = more important). The loop itself is
+    # FIFO regardless; the fleet router's brownout mode sheds by it.
+    priority: int = 0
 
     def events(self, timeout: Optional[float] = None) -> Iterator[Tuple]:
         """Yield stream events until (and including) the terminal
@@ -216,6 +220,15 @@ class EngineLoop:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()  # counters only
+        # Guards the terminal status check-and-set: a wedged-stop caller
+        # (_fail_outstanding) and a later-unwedging loop thread may race
+        # to deliver the same request's terminal; exactly one must win.
+        self._term_lock = threading.Lock()
+        # Set by _run on the way down when the engine (or a hook) raised —
+        # the fleet router reads it to distinguish "crashed" from
+        # "stopped" without parsing terminal reasons.
+        self.failure: Optional[BaseException] = None
+        self._draining = False
         self.counters: Dict[str, int] = {
             "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
             "errors": 0, "tokens_streamed": 0,
@@ -231,21 +244,91 @@ class EngineLoop:
         self._thread.start()
         return self
 
-    def stop(self, timeout: float = 30.0) -> None:
+    def stop(self, timeout: float = 30.0) -> bool:
         """Stop the loop thread. Outstanding requests get an ``error``
         terminal event ("shutdown") — a serving process going down does
-        not pretend in-flight work completed."""
+        not pretend in-flight work completed.
+
+        Returns True when the loop thread exited within ``timeout``. On
+        expiry the (daemon) thread is abandoned mid-turn, but its
+        outstanding requests are NOT stranded: this caller delivers
+        their error terminals itself — idempotent against the wedged
+        thread waking up later and running its own shutdown path — and
+        the timeout is surfaced as a warning plus the False return, so a
+        fleet drain can eject the replica instead of trusting it."""
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-            self._thread = None
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout=timeout)
+        if t.is_alive():
+            n_out = len(self._by_rid) + self._inbox.qsize()
+            warnings.warn(
+                f"EngineLoop.stop: loop thread still alive after "
+                f"{timeout}s; delivering error terminals for {n_out} "
+                f"outstanding request(s) from the stopping thread",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._fail_outstanding(f"shutdown timeout after {timeout}s")
+            return False
+        self._thread = None
+        return True
+
+    def _fail_outstanding(self, reason: str) -> int:
+        """Deliver ``error`` terminals for every request the loop thread
+        will never get to (the wedged-stop path). Runs on the STOPPING
+        thread and touches only host-side dicts and queues — the wedged
+        loop thread still owns the engine, so no device work, no
+        ``eng.cancel``. Returns how many terminals were delivered."""
+        n = 0
+        for req in list(self._by_rid.values()):
+            if req.status not in TERMINAL_STATUSES:
+                self._terminal(req, "error", reason=reason)
+                n += 1
+        with self._inbox_lock:
+            self._drained = True
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            self._terminal(req, "error", reason=reason)
+            n += 1
+        return n
 
     def __enter__(self) -> "EngineLoop":
         return self.start()
 
     def __exit__(self, *exc: Any) -> None:
         self.stop()
+
+    @property
+    def running(self) -> bool:
+        """True while the loop thread is alive and not stopping."""
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop accepting new work (``submit`` raises, ``/readyz`` reports
+        not-ready) while in-flight requests keep decoding — the first half
+        of the rolling-restart handshake: drain, redrive/finish, stop()."""
+        self._draining = True
+
+    def readiness(self) -> Dict[str, Any]:
+        """The ``/readyz`` signal, distinct from ``/healthz`` liveness: a
+        draining or stopped loop is alive (liveness ok) but must not
+        receive new traffic (readiness not ok)."""
+        return {
+            "ready": self.running and not self._draining,
+            "running": self.running,
+            "draining": self._draining,
+        }
 
     def submit(
         self,
@@ -254,6 +337,7 @@ class EngineLoop:
         *,
         deadline_s: Optional[float] = None,
         trace: Any = _TRACE_UNSET,
+        priority: int = 0,
     ) -> FrontendRequest:
         """Validate, pass admission, enqueue. Raises ``ValueError`` on a
         malformed request (gateway: 400), ``RejectedBusy`` (429) or
@@ -270,6 +354,8 @@ class EngineLoop:
         admission outcome + a ``rejected`` terminal."""
         if self._stop.is_set() or self._thread is None:
             raise RuntimeError("EngineLoop is not running")
+        if self._draining:
+            raise RuntimeError("EngineLoop is draining")
         if trace is _TRACE_UNSET:
             trace = (
                 self.tracer.begin_request() if self.tracer is not None else None
@@ -323,6 +409,7 @@ class EngineLoop:
                 submitted_s=now,
                 ticket=ticket,
                 trace=trace,
+                priority=int(priority),
             )
             with self._lock:
                 self.counters["submitted"] += 1
@@ -391,12 +478,18 @@ class EngineLoop:
         device dispatch) lets it grow without bound."""
         return max(0.0, self._clock() - self._last_turn)
 
+    @property
+    def active_requests(self) -> int:
+        """Requests in the system (inbox + engine), the router's load and
+        spill signal. A point-in-time read off host containers only."""
+        return len(self._by_rid) + self._inbox.qsize()
+
     def metrics(self) -> Dict[str, float]:
         """Counter snapshot for /metrics: loop counters + live gauges +
         the engine's numeric stats (prefixed ``engine_``) + admission."""
         with self._lock:
             out: Dict[str, float] = dict(self.counters)
-        out["active_requests"] = len(self._by_rid) + self._inbox.qsize()
+        out["active_requests"] = self.active_requests
         for k, v in list(self.engine.stats.items()):
             if isinstance(v, (int, float)):
                 out[f"engine_{k}"] = v
@@ -524,6 +617,7 @@ class EngineLoop:
                     self._wake.wait(self.idle_wait_s)
         except BaseException as e:
             failure = e
+            self.failure = e
             raise
         finally:
             # Runs on clean stop() AND when the engine (or a hook) raised:
@@ -629,9 +723,10 @@ class EngineLoop:
     }
 
     def _terminal(self, req: FrontendRequest, status: str, **info: Any) -> None:
-        if req.status in TERMINAL_STATUSES:
-            return
-        req.status = status
+        with self._term_lock:
+            if req.status in TERMINAL_STATUSES:
+                return
+            req.status = status
         eng = self.engine
         timing: Dict[str, float] = {}
         if req.rid is not None:

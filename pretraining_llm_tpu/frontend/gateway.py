@@ -16,10 +16,18 @@ device work. Endpoints:
                       (seconds since the last scheduler turn; 503 past
                       ``healthz_stale_after_s`` — a wedged loop must not
                       look like a healthy idle process);
+  GET  /readyz        readiness, distinct from liveness: 503 while the
+                      backend is draining or has no replica accepting
+                      traffic (rolling restarts pull a replica from the
+                      balancer via /readyz while /healthz stays green —
+                      alive-but-not-ready must not get new work);
   GET  /metrics       Prometheus text exposition: the loop's typed
                       registry (counters/histograms) when wired, plus
                       loop/engine/admission gauges and typed HTTP
                       counters (``..._total``).
+
+``loop`` is anything with the EngineLoop surface — a single EngineLoop or
+a fleet Router (frontend/router.py); the gateway never inspects which.
 
 Request schema (unknown keys are a 400 — a typo'd knob must not be
 silently ignored):
@@ -27,17 +35,28 @@ silently ignored):
   {"prompt": [1, 2, 3] | "text...",   # token ids, or text with a tokenizer
    "max_new_tokens": 32,              # required positive int
    "stream": false,                   # SSE streaming
-   "deadline_s": 2.5}                 # optional per-request deadline
+   "deadline_s": 2.5,                 # optional per-request deadline
+   "priority": 0}                     # brownout shedding order (fleet)
 
 Status mapping: validation error 400, backpressure 429 (+ Retry-After),
 infeasible/missed deadline 504, client-cancelled 499, engine failure 500.
 The body always carries the lifecycle latencies the engine measured
 (queue_wait_s / ttft_s / e2e_s).
+
+Retry-After semantics: the header on a 429 is the admission controller's
+base hint plus a small DETERMINISTIC jitter — a seeded PRNG sequence
+(``retry_jitter_seed``), not wall-clock randomness — so a burst of
+rejected clients that all honor the header fan out over
+``[base, base * (1 + retry_jitter_frac)]`` instead of thundering back in
+lockstep at a recovering fleet, while any run remains exactly
+reproducible under a fixed seed. Values are whole seconds (RFC 7231
+delta-seconds), never below 1.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
@@ -46,11 +65,10 @@ from pretraining_llm_tpu.frontend.admission import (
     RejectedBusy,
     RejectedInfeasible,
 )
-from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
 from pretraining_llm_tpu.observability.export import prometheus_lines
 
 _MAX_BODY_BYTES = 16 * 1024 * 1024
-_REQUEST_KEYS = {"prompt", "max_new_tokens", "stream", "deadline_s"}
+_REQUEST_KEYS = {"prompt", "max_new_tokens", "stream", "deadline_s", "priority"}
 
 
 class _BadRequest(Exception):
@@ -67,7 +85,7 @@ class ServingGateway:
 
     def __init__(
         self,
-        loop: EngineLoop,
+        loop: Any,
         *,
         host: str = "127.0.0.1",
         port: int = 8000,
@@ -75,11 +93,18 @@ class ServingGateway:
         decode: Optional[Callable[[Any], str]] = None,
         default_deadline_s: float = 0.0,
         healthz_stale_after_s: float = 0.0,
+        retry_jitter_frac: float = 0.25,
+        retry_jitter_seed: int = 0,
     ) -> None:
         if healthz_stale_after_s < 0:
             raise ValueError(
                 f"healthz_stale_after_s must be >= 0 (0 = disabled), got "
                 f"{healthz_stale_after_s}"
+            )
+        if not 0.0 <= retry_jitter_frac <= 1.0:
+            raise ValueError(
+                f"retry_jitter_frac must be in [0, 1] (0 = no jitter), got "
+                f"{retry_jitter_frac}"
             )
         self.loop = loop
         self.encode = encode
@@ -89,6 +114,12 @@ class ServingGateway:
         # legitimately hold the loop thread for minutes, so the threshold
         # is opt-in and deployment-tuned.
         self.healthz_stale_after_s = float(healthz_stale_after_s)
+        # Deterministic-seeded Retry-After jitter (see module docstring):
+        # one PRNG sequence per gateway, lock-guarded because handler
+        # threads draw from it concurrently.
+        self.retry_jitter_frac = float(retry_jitter_frac)
+        self._retry_rng = random.Random(int(retry_jitter_seed))
+        self._retry_rng_lock = threading.Lock()
         self._counters_lock = threading.Lock()
         self.http_counters: Dict[str, int] = {}
         gateway = self
@@ -156,8 +187,21 @@ class ServingGateway:
                 )
         return "\n".join(lines) + "\n"
 
+    def retry_after_header(self, base_s: float) -> str:
+        """Whole-second Retry-After value with deterministic-seeded jitter
+        over ``[base, base * (1 + retry_jitter_frac)]``; never below 1."""
+        with self._retry_rng_lock:
+            u = self._retry_rng.random()
+        jittered = float(base_s) * (1.0 + u * self.retry_jitter_frac)
+        return f"{max(1, round(jittered))}"
+
     def metrics_text(self) -> str:
         merged: Dict[str, float] = dict(self.loop.metrics())
+        render = getattr(self.loop, "render_metrics", None)
+        if render is not None:
+            # Fleet router: merged exposition over the fleet registry and
+            # every replica's labeled registry, then the HTTP counters.
+            return render(merged) + self._http_counter_lines()
         registry = getattr(self.loop, "registry", None)
         if registry is not None:
             # Typed series (counters + latency histograms) first, then the
@@ -227,6 +271,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 "completed": m.get("completed", 0),
                 "engine_loop_last_turn_age_s": round(age, 3),
             })
+        elif self.path == "/readyz":
+            gw = self.gateway
+            ready_fn = getattr(gw.loop, "readiness", None)
+            if ready_fn is None:
+                # Backend without drain support: ready iff alive enough to
+                # take a submit (best-effort parity with old behavior).
+                body = {"ready": True}
+            else:
+                body = dict(ready_fn())
+            ok = bool(body.get("ready", False))
+            body["status"] = "ready" if ok else "not-ready"
+            self._send_json(200 if ok else 503, body)
         elif self.path == "/metrics":
             body = self.gateway.metrics_text().encode()
             self.send_response(200)
@@ -258,7 +314,9 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         gw = self.gateway
         try:
             payload = self._read_json_body()
-            prompt, max_new, stream, deadline_s = self._parse_request(payload)
+            prompt, max_new, stream, deadline_s, priority = (
+                self._parse_request(payload)
+            )
         except _BadRequest as e:
             # Some rejections (missing/huge Content-Length) fire before the
             # body is read — same unread-body framing hazard as above.
@@ -277,7 +335,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         )
         try:
             req = gw.loop.submit(
-                prompt, max_new, deadline_s=deadline_s, trace=trace
+                prompt, max_new, deadline_s=deadline_s, trace=trace,
+                priority=priority,
             )
         except ValueError as e:
             # The engine's submit-time validation: the 4xx that replaces a
@@ -287,7 +346,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         except RejectedBusy as e:
             self._send_json(
                 429, {"error": f"overloaded: {e.reason}", **err_fields},
-                Retry_After=f"{max(1, round(e.retry_after_s))}",
+                Retry_After=gw.retry_after_header(e.retry_after_s),
             )
             return
         except RejectedInfeasible as e:
@@ -346,7 +405,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 raise _BadRequest("'deadline_s' must be > 0")
         elif self.gateway.default_deadline_s > 0:
             deadline_s = self.gateway.default_deadline_s
-        return prompt, max_new, stream, deadline_s
+        priority = payload.get("priority", 0)
+        if isinstance(priority, bool) or not isinstance(priority, int):
+            raise _BadRequest("'priority' must be an integer")
+        return prompt, max_new, stream, deadline_s, priority
 
     _STATUS_CODE = {"done": 200, "expired": 504, "cancelled": 499, "error": 500}
 

@@ -76,6 +76,13 @@ class LoadSpec:
     # export byte-for-byte. The in-process client instead reads back the
     # ids the loop's tracer minted.
     send_traceparent: bool = False
+    # Fleet/brownout scenario: fraction of requests marked high priority
+    # (``priority_hi``; the rest stay 0). Brownout shedding drops
+    # low-priority work first, so a mixed-priority workload shows the
+    # policy's selectivity. 0 (the default) consumes no rng — schedules
+    # stay byte-identical to specs that predate this field.
+    priority_hi_frac: float = 0.0
+    priority_hi: int = 1
 
     def __post_init__(self) -> None:
         if self.mode not in ("open", "closed"):
@@ -108,6 +115,11 @@ class LoadSpec:
             raise ValueError(
                 f"prefix_zipf must be >= 0, got {self.prefix_zipf}"
             )
+        if not 0.0 <= self.priority_hi_frac <= 1.0:
+            raise ValueError(
+                f"priority_hi_frac must be in [0, 1], got "
+                f"{self.priority_hi_frac}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +128,7 @@ class ScheduledRequest:
     arrival_s: float  # offset from workload start; 0.0 in closed-loop
     prompt: List[int]
     max_new: int
+    priority: int = 0
 
 
 def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
@@ -146,12 +159,17 @@ def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
         if pool:
             prompt = pool[rng.choices(range(len(pool)), weights)[0]] + prompt
         max_new = rng.randint(spec.max_new_min, spec.max_new_max)
+        priority = 0
+        if spec.priority_hi_frac > 0:  # rng consumed only when the scenario is on
+            if rng.random() < spec.priority_hi_frac:
+                priority = spec.priority_hi
         out.append(
             ScheduledRequest(
                 index=i,
                 arrival_s=t if spec.mode == "open" else 0.0,
                 prompt=prompt,
                 max_new=max_new,
+                priority=priority,
             )
         )
     return out
@@ -169,6 +187,9 @@ class RequestOutcome:
     # Prompt tokens the engine served from the prefix cache (0 with the
     # cache off; accumulates across preemption re-admissions).
     cached_tokens: int = 0
+    # Fleet client: how many times the router failed this request over to
+    # another replica before it finished (0 on a single loop).
+    redrives: int = 0
 
 
 def traceparent_for(spec: LoadSpec, index: int) -> str:
@@ -238,6 +259,7 @@ class LoadReport:
             "goodput_rps": n_ok / wall,
             "slo_attainment": (n_ok / len(self.outcomes)) if self.outcomes else 0.0,
             "cached_tokens_total": sum(o.cached_tokens for o in self.outcomes),
+            "redrives_total": sum(o.redrives for o in self.outcomes),
             "ttft": self.percentiles("ttft_s"),
             "tpot": self.percentiles("tpot_s"),
             "e2e": self.percentiles("e2e_s"),
@@ -303,7 +325,10 @@ def run_engine_loop(loop: Any, spec: LoadSpec) -> LoadReport:
     def client(sr: ScheduledRequest) -> RequestOutcome:
         t0 = time.monotonic()
         try:
-            req = loop.submit(sr.prompt, sr.max_new, deadline_s=spec.deadline_s)
+            req = loop.submit(
+                sr.prompt, sr.max_new, deadline_s=spec.deadline_s,
+                priority=sr.priority,
+            )
         except RejectedBusy:
             return RequestOutcome(sr.index, "rejected_busy")
         except RejectedInfeasible:
@@ -322,6 +347,7 @@ def run_engine_loop(loop: Any, spec: LoadSpec) -> LoadReport:
             e2e_s=info.get("e2e_s", time.monotonic() - t0),
             trace_id=info.get("trace_id"),
             cached_tokens=int(info.get("cached_tokens", 0)),
+            redrives=int(info.get("redrives", 0)),
         )
 
     return _execute(spec, client)
@@ -338,6 +364,8 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
         }
         if spec.deadline_s is not None:
             payload["deadline_s"] = spec.deadline_s
+        if sr.priority:
+            payload["priority"] = sr.priority
         data = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         trace_id = None
@@ -382,6 +410,92 @@ def run_http(base_url: str, spec: LoadSpec, timeout_s: float = 120.0) -> LoadRep
             e2e_s=body.get("e2e_s", time.monotonic() - t0),
             trace_id=body.get("trace_id", trace_id),
             cached_tokens=int(body.get("cached_tokens", 0)),
+            redrives=int(body.get("redrives", 0)),
         )
 
     return _execute(spec, client)
+
+
+# -- fleet choreography ----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetAction:
+    """One timed operation against a fleet Router while load is running:
+
+      kill     shadow the replica's live engine tick to raise (the loop
+               thread dies mid-decode; the router's health loop ejects the
+               replica and redrives its in-flight requests) — the
+               wall-clock analogue of the injector's ``replica_crash@req_n``;
+      drain    administrative drain: redrive in-flight work to survivors,
+               stop the loop, hold the replica not-ready;
+      restore  relaunch a drained/ejected replica with a fresh engine.
+    """
+
+    at_s: float
+    kind: str  # "kill" | "drain" | "restore"
+    replica: int
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("kill", "drain", "restore"):
+            raise ValueError(f"unknown fleet action kind {self.kind!r}")
+        if self.at_s < 0:
+            raise ValueError(f"at_s must be >= 0, got {self.at_s}")
+
+
+def rolling_restart_plan(
+    n_replicas: int, *, start_s: float, step_s: float
+) -> List[FleetAction]:
+    """Drain replica i at ``start_s + i*step_s``, restore it one step
+    later — at most one replica down at a time once ``step_s`` exceeds a
+    drain's duration (the standard rolling-restart invariant)."""
+    out: List[FleetAction] = []
+    for i in range(n_replicas):
+        t = start_s + i * step_s
+        out.append(FleetAction(at_s=t, kind="drain", replica=i))
+        out.append(FleetAction(at_s=t + step_s, kind="restore", replica=i))
+    return out
+
+
+def run_fleet_plan(router: Any, actions: List[FleetAction]) -> threading.Thread:
+    """Execute a fleet plan against ``router`` on a daemon thread (offsets
+    are from the call, so start it when the load run starts). Returns the
+    thread; join it after the load run to be sure every action fired."""
+    from pretraining_llm_tpu.resilience.faults import InjectedFault
+
+    plan = sorted(actions, key=lambda a: a.at_s)
+    start = time.monotonic()
+
+    def _kill(replica: int) -> None:
+        rep = router.replicas[replica]
+        eng = rep.engine
+        if eng is None:
+            return
+
+        def _boom(*a: Any, **k: Any) -> None:
+            raise InjectedFault(f"fleet plan killed replica {replica}")
+
+        # Same instance-attribute shadowing as ServingFaultInjector.wrap_tick;
+        # the loop thread dies on its next scheduler turn.
+        eng.pipeline_tick = _boom
+
+    def _run() -> None:
+        for act in plan:
+            delay = start + act.at_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                if act.kind == "kill":
+                    _kill(act.replica)
+                elif act.kind == "drain":
+                    router.drain(act.replica)
+                else:
+                    router.restore(act.replica)
+            except Exception:
+                # The plan is chaos against live infrastructure; a replica
+                # already down when its action fires is not a plan failure.
+                pass
+
+    th = threading.Thread(target=_run, name="fleet-plan", daemon=True)
+    th.start()
+    return th

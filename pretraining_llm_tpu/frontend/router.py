@@ -1,0 +1,876 @@
+"""Fleet router: one serving surface over N engine replicas.
+
+The router duck-types the EngineLoop surface the gateway consumes
+(``submit``/``cancel``/``metrics``/``last_turn_age_s``/``readiness``/
+``debug_*``/``tracer``), so ``serve.py --replicas N`` swaps it in without
+touching the HTTP layer. What it adds over a single loop:
+
+Placement — prefix-affinity with spill. Requests route by rendezvous hash
+of their prompt-prefix digest (first ``affinity_tokens`` tokens), so a hot
+prefix keeps landing on the replica whose prefix cache already holds it;
+when the affinity choice is ``spill_margin`` requests deeper than the
+least-loaded healthy replica, load wins over affinity (a hot prefix must
+not melt one replica while others idle).
+
+Health — ejection with exponential backoff. The health thread watches each
+active replica for a dead loop thread (engine crash) or a stale
+``last_turn_age_s`` past ``wedged_after_s`` (the serving twin of the
+training step watchdog: a wedged turn means a wedged device dispatch).
+Either verdict ejects the replica (stops routing), schedules a relaunch
+with doubling backoff, and redrives its work.
+
+Redrive — the robustness core. Every router request owns its committed
+token frontier (tokens already streamed to the client are never
+retracted). When a replica crashes, hangs, or is drained, its queued AND
+mid-decode requests fail over to survivors as ``prompt + committed_tokens``
+with ``max_new`` reduced by what was delivered; greedy decoding makes the
+continuation bit-identical to an undisturbed run, and the prefix cache
+makes the re-prefill cheap (the dead replica's pages are gone, but shared
+prefixes on survivors still hit). Failed-over requests keep their router
+request id (``frid``) and fleet admission ticket; ``redrives_total`` and
+per-request ``info["redrives"]`` account the cost.
+
+Brownout — partial capacity sheds partially. When the healthy fraction
+drops below ``brownout_min_healthy_frac``, the router sheds the work that
+can best tolerate it — priority below ``brownout_min_priority``, or
+deadline longer than ``brownout_max_deadline_s`` (longest-deadline work
+has the most slack to retry later) — with 429 + Retry-After instead of
+failing everything.
+
+Tracing caveat: a request's RequestTrace follows its FIRST attempt (the
+replica loop records queue/window spans into it and finishes it at that
+attempt's terminal). Redriven attempts run untraced; the fleet event
+stream (``fleet_req_submit``/``redrive``/``fleet_req_terminal`` keyed by
+``frid``) is the cross-attempt audit log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from pretraining_llm_tpu.frontend.admission import (
+    AdmissionController,
+    RejectedBusy,
+    RejectedInfeasible,
+    Ticket,
+)
+from pretraining_llm_tpu.frontend.engine_loop import (
+    _TRACE_UNSET,
+    TERMINAL_STATUSES,
+    FrontendRequest,
+)
+from pretraining_llm_tpu.frontend.replica import (
+    REPLICA_STATE_VALUES,
+    Replica,
+    ReplicaUnavailable,
+)
+from pretraining_llm_tpu.observability.capacity import DecisionLog
+from pretraining_llm_tpu.observability.metrics import render_merged
+
+
+def prefix_digest(prompt: Any, n_tokens: int) -> bytes:
+    """Stable digest of the routing prefix (first ``n_tokens`` ids)."""
+    h = hashlib.blake2b(digest_size=8)
+    for t in list(prompt)[:n_tokens]:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+def _rendezvous_score(digest: bytes, replica: int) -> int:
+    h = hashlib.blake2b(
+        digest + int(replica).to_bytes(4, "little"), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "little")
+
+
+class RouterRequest:
+    """One request as the CLIENT sees it, stable across redrives: the
+    stream surface mirrors FrontendRequest (``out_q`` carries
+    ``("token", t)`` then one ``("end", status, info)``;
+    ``events()``/``result()`` drain it), while ``_attempt`` — the current
+    per-replica FrontendRequest — may be replaced under ``_lock`` when the
+    router fails the request over."""
+
+    def __init__(
+        self,
+        frid: int,
+        prompt: List[int],
+        max_new: int,
+        *,
+        deadline: Optional[float],
+        submitted_s: float,
+        priority: int = 0,
+        ticket: Optional[Ticket] = None,
+        trace: Any = None,
+    ) -> None:
+        self.frid = frid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline  # absolute on the router clock, None = none
+        self.submitted_s = submitted_s
+        self.priority = priority
+        self.ticket = ticket
+        self.trace = trace
+        self.out_q: "queue.Queue[Tuple]" = queue.Queue()
+        self.status = "queued"
+        self.tokens: List[int] = []  # committed frontier (streamed, final)
+        self.info: Dict[str, Any] = {}
+        self.cancel_requested = False
+        self.redrives = 0
+        self.replica: Optional[int] = None
+        self._attempt: Optional[FrontendRequest] = None
+        self._lock = threading.Lock()
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[Tuple]:
+        while True:
+            try:
+                ev = self.out_q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no stream event within {timeout}s (status={self.status})"
+                )
+            yield ev
+            if ev[0] == "end":
+                return
+
+    def result(
+        self, timeout: Optional[float] = None
+    ) -> Tuple[str, List[int], Dict[str, Any]]:
+        for _ in self.events(timeout=timeout):
+            pass
+        return self.status, self.tokens, self.info
+
+
+class Router:
+    """See module docstring. ``replicas`` are constructed outside (they
+    carry the engine factories); the router starts/stops them with itself.
+
+    ``admission`` is the FLEET budget (scope it with ``scope="fleet"`` on
+    a shared registry); each replica's own controller still applies at its
+    loop. ``registry`` holds the fleet-level typed series
+    (``replica_state``, ``redrives_total``, brownout) and leads the merged
+    exposition.
+    """
+
+    def __init__(
+        self,
+        replicas: List[Replica],
+        *,
+        admission: Optional[AdmissionController] = None,
+        bus: Any = None,
+        registry: Any = None,
+        tracer: Any = None,
+        clock: Any = time.monotonic,
+        affinity_tokens: int = 32,
+        spill_margin: int = 4,
+        wedged_after_s: float = 0.0,
+        eject_backoff_s: float = 0.5,
+        eject_backoff_max_s: float = 8.0,
+        redrive_max: int = 3,
+        health_interval_s: float = 0.02,
+        brownout_min_healthy_frac: float = 0.0,
+        brownout_min_priority: int = 1,
+        brownout_max_deadline_s: float = 0.0,
+    ) -> None:
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        if affinity_tokens < 1:
+            raise ValueError(
+                f"affinity_tokens must be >= 1, got {affinity_tokens}"
+            )
+        if spill_margin < 1:
+            raise ValueError(f"spill_margin must be >= 1, got {spill_margin}")
+        if redrive_max < 0:
+            raise ValueError(f"redrive_max must be >= 0, got {redrive_max}")
+        if not 0.0 <= brownout_min_healthy_frac <= 1.0:
+            raise ValueError(
+                f"brownout_min_healthy_frac must be in [0, 1], got "
+                f"{brownout_min_healthy_frac}"
+            )
+        self.replicas = list(replicas)
+        self.admission = admission
+        self.bus = bus
+        self.registry = registry
+        self.tracer = tracer
+        self._clock = clock
+        self.affinity_tokens = int(affinity_tokens)
+        self.spill_margin = int(spill_margin)
+        self.wedged_after_s = float(wedged_after_s)
+        self.eject_backoff_s = float(eject_backoff_s)
+        self.eject_backoff_max_s = float(eject_backoff_max_s)
+        self.redrive_max = int(redrive_max)
+        self.health_interval_s = float(health_interval_s)
+        self.brownout_min_healthy_frac = float(brownout_min_healthy_frac)
+        self.brownout_min_priority = int(brownout_min_priority)
+        self.brownout_max_deadline_s = float(brownout_max_deadline_s)
+        self.decisions = DecisionLog(maxlen=256, bus=bus)
+        self._live: Dict[int, RouterRequest] = {}
+        self._live_lock = threading.Lock()
+        self._next_frid = 0
+        self._stopping = False
+        self._started = clock()
+        self._stop_ev = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._backoff: Dict[int, float] = {}
+        self._relaunch_at: Dict[int, float] = {}
+        self.brownout_active = False
+        self._counters_lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
+            "errors": 0, "redrives": 0, "brownout_shed": 0, "ejects": 0,
+        }
+        self._g_state: Dict[int, Any] = {}
+        self._c_redrives = self._c_shed = self._c_ejects = None
+        self._g_brownout = None
+        if registry is not None:
+            for rep in self.replicas:
+                self._g_state[rep.index] = registry.gauge(
+                    "replica_state",
+                    "replica lifecycle (0=ejected, 1=active, 2=draining)",
+                    replica=rep.index,
+                )
+            self._c_redrives = registry.counter(
+                "redrives_total",
+                "in-flight requests failed over to a surviving replica")
+            self._c_shed = registry.counter(
+                "brownout_shed_total",
+                "requests shed at the router during brownout")
+            self._c_ejects = registry.counter(
+                "replica_ejects_total",
+                "replicas declared dead/wedged by the health loop")
+            self._g_brownout = registry.gauge(
+                "brownout_active", "1 while the fleet is in brownout")
+        for rep in self.replicas:
+            rep.on_state = self._on_replica_state
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Router":
+        for rep in self.replicas:
+            if rep.loop is None:
+                rep.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="router-health", daemon=True
+        )
+        self._health_thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Stop the fleet. In-flight requests get error terminals (via
+        each loop's shutdown path); returns False if any loop thread had
+        to be abandoned wedged."""
+        self._stopping = True
+        self._stop_ev.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
+        clean = True
+        for rep in self.replicas:
+            clean = rep.stop(timeout=timeout) and clean
+        # Belt and suspenders: anything the loops could not terminate
+        # (e.g. a request whose attempt was abandoned mid-redrive when
+        # stop hit) gets its terminal here, so no client hangs.
+        for rreq in self._live_snapshot():
+            with rreq._lock:
+                self._finish_locked(rreq, "error", {"reason": "router shutdown"})
+        return clean
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline_s: Optional[float] = None,
+        trace: Any = _TRACE_UNSET,
+        priority: int = 0,
+    ) -> RouterRequest:
+        """Gateway-facing submit: validate, brownout gate, fleet
+        admission, place on a replica, start the pump. Raises exactly what
+        EngineLoop.submit raises (ValueError / RejectedBusy /
+        RejectedInfeasible / RuntimeError) so the gateway's status mapping
+        is unchanged."""
+        if self._stopping:
+            raise RuntimeError("Router is stopped")
+        if trace is _TRACE_UNSET:
+            trace = (
+                self.tracer.begin_request() if self.tracer is not None else None
+            )
+        engine = next(
+            (r.engine for r in self.replicas if r.engine is not None), None
+        )
+        if engine is None:
+            raise RuntimeError("Router has no launched replica")
+        try:
+            max_new = engine.validate_request(prompt, max_new_tokens)
+        except ValueError:
+            if self.bus is not None:
+                self.bus.emit("req_rejected", reason="invalid", fleet=True)
+            if trace is not None:
+                trace.finish("rejected", reason="invalid")
+            raise
+        prompt = [int(t) for t in prompt]
+        if self.brownout_active and self._brownout_sheds(priority, deadline_s):
+            retry = (
+                self.admission.retry_after_s
+                if self.admission is not None else 1.0
+            )
+            reason = (
+                f"fleet brownout: shedding priority<"
+                f"{self.brownout_min_priority} / long-deadline work"
+            )
+            with self._counters_lock:
+                self.counters["brownout_shed"] += 1
+            if self._c_shed is not None:
+                self._c_shed.inc()
+            self.decisions.record(
+                "brownout_shed", priority=priority, deadline_s=deadline_s,
+                trace_id=trace.trace_id if trace is not None else None,
+            )
+            if trace is not None:
+                trace.finish("rejected", reason="brownout")
+            raise RejectedBusy(reason, retry)
+        ticket = None
+        if self.admission is not None:
+            cached = self._best_cached(prompt)
+            try:
+                ticket = self.admission.try_admit(
+                    len(prompt), max_new, deadline_s=deadline_s,
+                    cached_tokens=cached,
+                )
+            except (RejectedBusy, RejectedInfeasible):
+                if self.bus is not None:
+                    self.bus.emit(
+                        "req_rejected", reason="fleet_budget", fleet=True,
+                    )
+                if trace is not None:
+                    trace.finish("rejected", reason="fleet_budget")
+                raise
+        now = self._clock()
+        with self._live_lock:
+            frid = self._next_frid
+            self._next_frid += 1
+        rreq = RouterRequest(
+            frid, prompt, max_new,
+            deadline=(now + deadline_s) if deadline_s is not None else None,
+            submitted_s=now, priority=int(priority), ticket=ticket,
+            trace=trace,
+        )
+        try:
+            with rreq._lock:
+                replica = self._assign_locked(rreq, exclude=set())
+        except BaseException:
+            if ticket is not None:
+                self.admission.release(ticket)
+            raise
+        with self._live_lock:
+            self._live[frid] = rreq
+        with self._counters_lock:
+            self.counters["submitted"] += 1
+        if self.bus is not None:
+            fields = {"trace_id": trace.trace_id} if trace is not None else {}
+            self.bus.emit(
+                "fleet_req_submit", frid=frid, replica=replica,
+                n_prompt=len(prompt), max_new=max_new, priority=priority,
+                **fields,
+            )
+        return rreq
+
+    def cancel(self, rreq: RouterRequest) -> None:
+        rreq.cancel_requested = True
+        with rreq._lock:
+            attempt, idx = rreq._attempt, rreq.replica
+        if attempt is None or idx is None:
+            return
+        loop = self.replicas[idx].loop
+        if loop is not None:
+            loop.cancel(attempt)
+
+    def _brownout_sheds(
+        self, priority: int, deadline_s: Optional[float]
+    ) -> bool:
+        if priority < self.brownout_min_priority:
+            return True
+        if self.brownout_max_deadline_s > 0 and (
+            deadline_s is None or deadline_s > self.brownout_max_deadline_s
+        ):
+            return True
+        return False
+
+    def _best_cached(self, prompt: List[int]) -> int:
+        """Fleet admission's prefix-cache hint: the BEST hit any replica
+        could serve (optimistic — affinity usually sends the request
+        there, and an optimistic hint only discounts the token budget,
+        never unsounds it)."""
+        best = 0
+        for rep in self.replicas:
+            cache = getattr(rep.engine, "prefix_cache", None)
+            if cache is not None and rep.accepting:
+                try:
+                    best = max(best, cache.peek(prompt))
+                except Exception:
+                    pass
+        return best
+
+    # -- placement ----------------------------------------------------------
+
+    def _pick(self, prompt: List[int], tried: Set[int]) -> Optional[Replica]:
+        cands = [
+            r for r in self.replicas
+            if r.index not in tried and r.accepting
+        ]
+        if not cands:
+            return None
+        digest = prefix_digest(prompt, self.affinity_tokens)
+        by_score = sorted(
+            cands, key=lambda r: _rendezvous_score(digest, r.index),
+            reverse=True,
+        )
+        chosen = by_score[0]
+        loads = {r.index: r.load() for r in cands}
+        min_load = min(loads.values())
+        if loads[chosen.index] >= min_load + self.spill_margin:
+            # Affinity lost to imbalance: take the least-loaded candidate,
+            # rendezvous order breaking ties so the spill is deterministic.
+            chosen = min(
+                by_score, key=lambda r: (loads[r.index], by_score.index(r))
+            )
+        return chosen
+
+    def _assign_locked(
+        self, rreq: RouterRequest, exclude: Set[int]
+    ) -> int:
+        """Place ``rreq``'s next attempt (rreq._lock held). Walks replicas
+        in affinity order, spilling past busy/unavailable ones; raises the
+        last rejection when nobody can take it."""
+        tried: Set[int] = set(exclude)
+        last_exc: Optional[Exception] = None
+        delivered = len(rreq.tokens)
+        deadline_s = None
+        if rreq.deadline is not None:
+            deadline_s = rreq.deadline - self._clock()
+            if deadline_s <= 0:
+                raise RejectedInfeasible("deadline already expired", 0.0)
+        # The continuation resumes from the committed frontier; greedy
+        # decoding makes it bit-identical to the undisturbed suffix.
+        prompt = rreq.prompt + rreq.tokens if delivered else rreq.prompt
+        max_new = rreq.max_new - delivered
+        trace = rreq.trace if rreq.redrives == 0 else None
+        while True:
+            rep = self._pick(prompt, tried)
+            if rep is None:
+                raise last_exc if last_exc is not None else RejectedBusy(
+                    "no replica available",
+                    self.admission.retry_after_s
+                    if self.admission is not None else 1.0,
+                )
+            tried.add(rep.index)
+            try:
+                # A busy replica's loop finishes the trace "rejected" as a
+                # side effect; don't hand a finished trace to the next try.
+                t = trace if trace is not None and not trace.finished else None
+                attempt = rep.submit(
+                    prompt, max_new, deadline_s=deadline_s, trace=t,
+                    priority=rreq.priority,
+                )
+            except (ReplicaUnavailable, RuntimeError) as e:
+                last_exc = RejectedBusy(
+                    str(e),
+                    self.admission.retry_after_s
+                    if self.admission is not None else 1.0,
+                )
+                continue
+            except RejectedBusy as e:
+                last_exc = e
+                continue
+            rreq._attempt = attempt
+            rreq.replica = rep.index
+            threading.Thread(
+                target=self._pump,
+                args=(rreq, attempt, rep.index),
+                name=f"pump-{rreq.frid}.{rreq.redrives}",
+                daemon=True,
+            ).start()
+            return rep.index
+
+    # -- pump (one thread per attempt) --------------------------------------
+
+    def _pump(
+        self, rreq: RouterRequest, attempt: FrontendRequest, rep_index: int
+    ) -> None:
+        """Forward one attempt's stream to the router request, redriving
+        on replica failure. Abandonment protocol: whoever replaces
+        ``rreq._attempt`` under the lock owns the stream from then on; a
+        pump that observes the mismatch exits silently (a non-event tuple
+        pushed onto the old attempt's queue wakes a blocked pump)."""
+        for ev in attempt.events():
+            if ev[0] == "token":
+                with rreq._lock:
+                    if rreq._attempt is not attempt:
+                        return
+                    rreq.tokens.append(ev[1])
+                    rreq.out_q.put(("token", ev[1]))
+                continue
+            if ev[0] != "end":  # abandonment wake-up marker
+                with rreq._lock:
+                    if rreq._attempt is not attempt:
+                        return
+                continue
+            _, status, info = ev
+            with rreq._lock:
+                if rreq._attempt is not attempt:
+                    return
+                if (
+                    status == "error"
+                    and self._redrivable(info)
+                    and not rreq.cancel_requested
+                    and not self._stopping
+                    and rreq.redrives < self.redrive_max
+                ):
+                    if self._redrive_locked(
+                        rreq, rep_index,
+                        str(info.get("reason", "replica failure")),
+                    ):
+                        return
+                self._finish_locked(rreq, status, info)
+            return
+
+    @staticmethod
+    def _redrivable(info: Dict[str, Any]) -> bool:
+        """Error terminals that mean 'the REPLICA failed, not the
+        request': engine crash, loop shutdown under the request, wedged
+        stop. Anything else (per-request validation fallback) stays an
+        error to the client."""
+        reason = str(info.get("reason", ""))
+        return (
+            reason.startswith("engine failure")
+            or reason.startswith("shutdown")
+            or reason.startswith("drain")
+        )
+
+    def _redrive_locked(
+        self, rreq: RouterRequest, from_idx: int, reason: str
+    ) -> bool:
+        """Fail ``rreq`` over to a survivor (rreq._lock held). Returns
+        True when the request found a new home (or finished outright);
+        False means the caller should deliver the failure terminal."""
+        delivered = len(rreq.tokens)
+        # Abandon the old attempt unconditionally: every path below either
+        # re-homes the request or terminates it, and a pump blocked on a
+        # wedged replica's stream must be woken to exit either way.
+        old_attempt = rreq._attempt
+        rreq._attempt = None
+        if old_attempt is not None:
+            old_attempt.out_q.put(("abandoned", None))
+        if rreq.deadline is not None and self._clock() >= rreq.deadline:
+            self._finish_locked(
+                rreq, "expired", {"reason": "deadline passed during redrive"}
+            )
+            return True
+        if delivered >= rreq.max_new:
+            # The replica died between the last committed token and its
+            # finish bookkeeping: the client already has the whole greedy
+            # output, so this IS completion.
+            self._finish_locked(rreq, "done", {"completed_at_redrive": True})
+            return True
+        rreq.redrives += 1
+        try:
+            to_idx = self._assign_locked(rreq, exclude={from_idx})
+        except (RejectedBusy, RejectedInfeasible, RuntimeError, ValueError) as e:
+            self._finish_locked(
+                rreq, "error",
+                {"reason": f"redrive failed: {e}", "redrive_from": from_idx},
+            )
+            return True
+        with self._counters_lock:
+            self.counters["redrives"] += 1
+        if self._c_redrives is not None:
+            self._c_redrives.inc()
+        self.decisions.record(
+            "redrive", frid=rreq.frid, from_replica=from_idx,
+            to_replica=to_idx, n_committed=delivered, reason=reason,
+            trace_id=rreq.trace.trace_id if rreq.trace is not None else None,
+        )
+        if self.bus is not None:
+            self.bus.emit(
+                "redrive", frid=rreq.frid, from_replica=from_idx,
+                to_replica=to_idx, n_committed=delivered,
+                n_prompt=len(rreq.prompt), reason=reason,
+            )
+        return True
+
+    def _finish_locked(
+        self, rreq: RouterRequest, status: str, info: Dict[str, Any]
+    ) -> None:
+        """Deliver the router-level terminal exactly once (rreq._lock
+        held); later callers (a racing pump vs. shutdown sweep) no-op."""
+        if rreq.status in TERMINAL_STATUSES:
+            return
+        rreq.status = status
+        info = dict(info)
+        info["redrives"] = rreq.redrives
+        info["n_tokens"] = len(rreq.tokens)
+        # Router-level e2e spans ALL attempts; the attempt-local timings
+        # (ttft/queue_wait) describe only the last one.
+        info["e2e_s"] = self._clock() - rreq.submitted_s
+        if rreq.trace is not None:
+            info.setdefault("trace_id", rreq.trace.trace_id)
+        rreq.info = info
+        if self.admission is not None and rreq.ticket is not None:
+            self.admission.release(rreq.ticket)
+        with self._live_lock:
+            self._live.pop(rreq.frid, None)
+        counter = {
+            "done": "completed", "cancelled": "cancelled",
+            "expired": "expired", "error": "errors",
+        }[status]
+        with self._counters_lock:
+            self.counters[counter] += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "fleet_req_terminal", frid=rreq.frid, status=status,
+                redrives=rreq.redrives, n_tokens=len(rreq.tokens),
+                replica=rreq.replica, e2e_s=info["e2e_s"],
+            )
+        rreq.out_q.put(("end", status, info))
+
+    def _live_snapshot(self) -> List[RouterRequest]:
+        with self._live_lock:
+            return list(self._live.values())
+
+    # -- health / drain / brownout ------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop_ev.wait(self.health_interval_s):
+            now = self._clock()
+            for rep in self.replicas:
+                if rep.state == "active":
+                    loop = rep.loop
+                    if loop is None or not loop.running:
+                        self._eject(rep, "loop dead (engine crash)")
+                        continue
+                    age = loop.last_turn_age_s()
+                    if (
+                        self.wedged_after_s > 0
+                        and age > self.wedged_after_s
+                        and loop.active_requests > 0
+                    ):
+                        self._eject(rep, f"wedged: last turn {age:.2f}s ago")
+                elif rep.state == "ejected":
+                    at = self._relaunch_at.get(rep.index)
+                    if at is not None and now >= at:
+                        self._relaunch_at.pop(rep.index, None)
+                        try:
+                            rep.relaunch(stop_timeout=0.5)
+                        except Exception:
+                            backoff = self._next_backoff(rep.index)
+                            self._relaunch_at[rep.index] = (
+                                self._clock() + backoff
+                            )
+            self._update_brownout()
+
+    def _next_backoff(self, index: int) -> float:
+        cur = self._backoff.get(index, self.eject_backoff_s)
+        self._backoff[index] = min(cur * 2.0, self.eject_backoff_max_s)
+        return cur
+
+    def _eject(self, rep: Replica, reason: str) -> None:
+        rep.eject(reason)
+        with self._counters_lock:
+            self.counters["ejects"] += 1
+        if self._c_ejects is not None:
+            self._c_ejects.inc()
+        self.decisions.record(
+            "eject_replica", replica=rep.index, reason=reason,
+            generation=rep.generation,
+        )
+        backoff = self._next_backoff(rep.index)
+        self._relaunch_at[rep.index] = self._clock() + backoff
+        self._redrive_from(rep.index, reason)
+
+    def drain(self, index: int, *, stop_timeout: float = 5.0) -> bool:
+        """Administrative drain: stop routing to the replica, redrive its
+        in-flight work to survivors, then stop its loop. The replica
+        stays ``draining`` (not-ready on /readyz) until ``restore``."""
+        rep = self.replicas[index]
+        rep.drain()
+        self._redrive_from(index, "drain")
+        return rep.stop(timeout=stop_timeout)
+
+    def restore(self, index: int) -> None:
+        """Bring a drained/ejected replica back with a fresh engine (the
+        second half of a rolling restart) and reset its backoff."""
+        rep = self.replicas[index]
+        rep.relaunch()
+        self._backoff.pop(index, None)
+        self._relaunch_at.pop(index, None)
+
+    def _redrive_from(self, index: int, reason: str) -> None:
+        """Fail over every live request currently on ``index``. Races
+        benignly with the pumps doing the same from the terminal side:
+        both paths take rreq._lock, and whoever moves ``_attempt`` first
+        wins (the loser sees the mismatch / the changed replica)."""
+        for rreq in self._live_snapshot():
+            with rreq._lock:
+                if rreq.status in TERMINAL_STATUSES:
+                    continue
+                if rreq.replica != index or rreq._attempt is None:
+                    continue
+                if rreq.cancel_requested or self._stopping:
+                    continue
+                if rreq.redrives >= self.redrive_max:
+                    self._finish_locked(
+                        rreq, "error",
+                        {"reason": f"redrive budget exhausted after {reason}"},
+                    )
+                    continue
+                self._redrive_locked(rreq, index, reason)
+
+    def _update_brownout(self) -> None:
+        if self.brownout_min_healthy_frac <= 0:
+            return
+        total = len(self.replicas)
+        healthy = sum(1 for r in self.replicas if r.accepting)
+        want = (healthy / total) < self.brownout_min_healthy_frac
+        if want == self.brownout_active:
+            return
+        self.brownout_active = want
+        if self._g_brownout is not None:
+            self._g_brownout.set(1.0 if want else 0.0)
+        if self.bus is not None:
+            self.bus.emit(
+                "brownout", active=want, healthy=healthy, total=total
+            )
+
+    def _on_replica_state(self, rep: Replica, state: str, reason: str) -> None:
+        g = self._g_state.get(rep.index)
+        if g is not None:
+            g.set(REPLICA_STATE_VALUES[state])
+
+    # -- gateway surface (parity with EngineLoop) ----------------------------
+
+    def last_turn_age_s(self) -> float:
+        """Fleet liveness: the FRESHEST active replica's turn age — one
+        healthy replica keeps /healthz green (capacity is /readyz's and
+        brownout's business, not liveness's)."""
+        ages = [
+            rep.loop.last_turn_age_s()
+            for rep in self.replicas
+            if rep.state == "active" and rep.loop is not None
+        ]
+        if not ages:
+            return max(0.0, self._clock() - self._started)
+        return min(ages)
+
+    def readiness(self) -> Dict[str, Any]:
+        per = {rep.index: rep.state for rep in self.replicas}
+        ready = any(rep.accepting for rep in self.replicas)
+        return {
+            "ready": ready,
+            "replicas": per,
+            "brownout": self.brownout_active,
+        }
+
+    def metrics(self) -> Dict[str, float]:
+        """Aggregated counter snapshot (the /metrics extra-gauges path):
+        fleet counters + per-replica loop counters summed + fleet
+        admission, mirroring EngineLoop.metrics keys so /healthz and
+        existing dashboards keep working."""
+        with self._counters_lock:
+            out: Dict[str, float] = dict(self.counters)
+        agg: Dict[str, float] = {}
+        active = 0
+        for rep in self.replicas:
+            loop = rep.loop
+            if loop is None:
+                continue
+            if rep.accepting:
+                active += 1
+            for k, v in loop.metrics().items():
+                if k.startswith("admission_"):
+                    continue  # per-replica budgets; fleet budget below
+                agg[k] = agg.get(k, 0.0) + v
+        for k in ("active_requests", "tokens_streamed"):
+            if k in agg:
+                out[k] = agg[k]
+        for k, v in agg.items():
+            if k.startswith("engine_"):
+                out[k] = v
+        # "_count" not "_total": these are gauges and the exposition linter
+        # reserves the _total suffix for counters.
+        out["replicas_count"] = len(self.replicas)
+        out["replicas_active"] = active
+        out["brownout_active"] = 1.0 if self.brownout_active else 0.0
+        if self.admission is not None:
+            for k, v in self.admission.snapshot().items():
+                out[f"admission_{k}"] = v
+        return out
+
+    def render_metrics(self, extra_gauges: Optional[Dict[str, float]] = None) -> str:
+        """One merged exposition: the fleet registry leads, each
+        replica's labeled registry follows (see metrics.render_merged)."""
+        regs = []
+        if self.registry is not None:
+            regs.append(self.registry)
+        regs.extend(rep.registry for rep in self.replicas)
+        if not regs:
+            from pretraining_llm_tpu.observability.export import (
+                prometheus_lines,
+            )
+            return prometheus_lines(
+                extra_gauges or {}, prefix="pllm_serving_"
+            )
+        return render_merged(regs, extra_gauges)
+
+    def debug_requests(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for rep in self.replicas:
+            if rep.loop is None:
+                continue
+            for rec in rep.loop.debug_requests():
+                rec["replica"] = rep.index
+                out.append(rec)
+        for rreq in self._live_snapshot():
+            out.append({
+                "frid": rreq.frid,
+                "status": rreq.status,
+                "replica": rreq.replica,
+                "redrives": rreq.redrives,
+                "n_tokens": len(rreq.tokens),
+                "priority": rreq.priority,
+                "fleet": True,
+            })
+        return out
+
+    def debug_engine(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "fleet": {
+                "replicas": [rep.debug_snapshot() for rep in self.replicas],
+                "brownout_active": self.brownout_active,
+                "live_requests": len(self._live_snapshot()),
+                "counters": dict(self.counters),
+                "decisions": {
+                    "counts": self.decisions.counts_snapshot(),
+                    "tail": self.decisions.tail(16),
+                },
+            },
+        }
+        if self.admission is not None:
+            out["fleet"]["admission"] = self.admission.snapshot()
+        out["replicas"] = {
+            str(rep.index): rep.loop.debug_engine()
+            for rep in self.replicas
+            if rep.loop is not None and rep.alive
+        }
+        return out

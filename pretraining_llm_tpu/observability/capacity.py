@@ -52,6 +52,11 @@ DECISION_KINDS = (
     "evict_cold",         # cold prefix-cache blocks reclaimed for a live row
     "reclaim_spec",       # speculative page grants rolled back under pressure
     "expire_inflight",    # deadline passed mid-decode -> cancelled (504)
+    # Fleet-tier decisions (frontend/router.py): each costs a request a
+    # retry, a re-prefill, or its slot, so they live in the same ledger.
+    "eject_replica",      # router declared a replica dead/wedged and stopped routing to it
+    "redrive",            # an in-flight request failed over to a surviving replica
+    "brownout_shed",      # fleet degraded: low-priority work shed at the router
 )
 
 

@@ -65,6 +65,16 @@ EVENT_KINDS = (
     # trace_id joins it to the req_* stream).
     "cap_window",     # per-window occupancy sample: rows, tokens, pool, queue
     "decision",       # scheduler decision: reject/shed/preempt/evict/reclaim
+    # Serving fleet (frontend/router.py). Replica-scoped events carry a
+    # ``replica`` index (replica-local req_* events carry it too, via the
+    # router's tagging bus proxy); fleet_req_* events carry ``frid`` — the
+    # router-level request id that stays stable across redrives, which is
+    # what lets obs_report --fleet prove no accepted request was lost.
+    "replica_state",      # lifecycle transition: replica, state, reason
+    "redrive",            # in-flight failover: frid, from/to replica, committed tokens
+    "brownout",           # fleet brownout entered/left: active, healthy, total
+    "fleet_req_submit",   # router accepted a request: frid, replica, n_prompt
+    "fleet_req_terminal", # router delivered a terminal: frid, status, redrives
 )
 
 

@@ -31,12 +31,26 @@ checkpoint and runs clean instead of wedging forever.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal
+import threading
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt_truncate")
+
+# Serving-path fault kinds (frontend/router.py drills). Same philosophy as
+# the training kinds — every fleet recovery path must be exercisable on CPU
+# in tier-1 — but the trigger is a REQUEST count, not a step count: serving
+# has no step clock, and "the Nth submission to a replica" is deterministic
+# under a seeded load schedule.
+SERVING_FAULT_KINDS = (
+    "replica_crash",  # next scheduler turn on the replica raises -> loop dies
+    "replica_hang",   # next scheduler turn blocks (wedged-engine drill)
+    "slow_window",    # next few turns run with an injected delay (SLO drill)
+    "reject_storm",   # next few submissions to the replica are refused busy
+)
 
 # How long an injected hang blocks the host loop. Effectively forever next to
 # any sane watchdog timeout; bounded so a test run without a watchdog still
@@ -140,6 +154,175 @@ class FaultInjector:
     @staticmethod
     def _noop(trainer: Any) -> None:  # pragma: no cover
         pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingFault:
+    """One parsed serving-fault entry: fire ``kind`` when a replica sees
+    its ``at_submit``-th accepted submission. ``replica=None`` means any
+    replica (whichever reaches the count first)."""
+
+    kind: str
+    at_submit: int
+    replica: Optional[int] = None
+
+
+def parse_serving_faults(spec: str) -> List[ServingFault]:
+    """Parse a serving fault plan: comma-separated ``kind@reqN`` entries,
+    optionally replica-scoped as ``kind@reqN:rM`` (e.g.
+    ``"replica_crash@req3,slow_window@req1:r0"``). Raises ValueError
+    naming the offending entry."""
+    out: List[ServingFault] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, sep, at = entry.partition("@")
+        if not sep or not at or not at.startswith("req"):
+            raise ValueError(
+                f"malformed serving fault entry {entry!r} in {spec!r}: "
+                f"expected kind@reqN or kind@reqN:rM"
+            )
+        if kind not in SERVING_FAULT_KINDS:
+            raise ValueError(
+                f"unknown serving fault kind {kind!r} in {spec!r}; one of "
+                f"{SERVING_FAULT_KINDS}"
+            )
+        at = at[len("req"):]
+        at, rsep, rep = at.partition(":")
+        replica: Optional[int] = None
+        if rsep:
+            if not rep.startswith("r"):
+                raise ValueError(
+                    f"malformed replica scope in {entry!r} (plan {spec!r}): "
+                    f"expected :rM"
+                )
+            try:
+                replica = int(rep[1:])
+            except ValueError:
+                raise ValueError(
+                    f"replica index must be an integer in {entry!r} "
+                    f"(plan {spec!r})"
+                ) from None
+            if replica < 0:
+                raise ValueError(
+                    f"replica index must be >= 0 in {entry!r} (plan {spec!r})"
+                )
+        try:
+            n = int(at)
+        except ValueError:
+            raise ValueError(
+                f"fault request count must be an integer in {entry!r} "
+                f"(plan {spec!r})"
+            ) from None
+        if n < 1:
+            raise ValueError(
+                f"fault request count must be >= 1 in {entry!r} "
+                f"(plan {spec!r})"
+            )
+        out.append(ServingFault(kind, n, replica))
+    if not out:
+        raise ValueError(f"empty serving fault plan {spec!r}")
+    return out
+
+
+class InjectedFault(RuntimeError):
+    """Raised inside a replica's scheduler turn by ``replica_crash`` — the
+    engine loop's failure path treats it like any real engine error."""
+
+
+class ServingFaultInjector:
+    """Fires a parsed serving plan against a fleet of replicas, once per
+    entry. Shared across the fleet: each Replica reports its accepted
+    submissions via ``on_submit`` (router/gateway threads) which ARMS the
+    matching entries; the armed action then fires at the replica's next
+    scheduler turn via the ``wrap_tick`` shim (loop thread) or, for
+    ``reject_storm``, at its next submissions via ``should_reject``.
+
+    Arming at submit + firing at the turn boundary keeps the drill honest:
+    a crash lands while the triggering request (at least) is in flight, so
+    the redrive path — not just fresh routing — is what recovers it.
+    """
+
+    def __init__(
+        self,
+        spec: str,
+        *,
+        bus: Any = None,
+        slow_ticks: int = 4,
+        slow_s: float = 0.05,
+        storm_rejects: int = 4,
+    ) -> None:
+        self.plan = parse_serving_faults(spec)
+        self.bus = bus
+        self.slow_ticks = int(slow_ticks)
+        self.slow_s = float(slow_s)
+        self.storm_rejects = int(storm_rejects)
+        self._lock = threading.Lock()
+        self._fired: set = set()
+        self._armed: Dict[int, List[str]] = {}   # replica -> crash/hang queue
+        self._slow: Dict[int, int] = {}          # replica -> slowed ticks left
+        self._storm: Dict[int, int] = {}         # replica -> rejects left
+
+    def on_submit(self, replica: int, nth_submit: int) -> None:
+        """Called by a Replica after accepting its ``nth_submit``-th
+        request; arms any plan entries that trigger there."""
+        with self._lock:
+            for i, f in enumerate(self.plan):
+                if (
+                    i in self._fired
+                    or f.at_submit != nth_submit
+                    or (f.replica is not None and f.replica != replica)
+                ):
+                    continue
+                self._fired.add(i)
+                if self.bus is not None:
+                    self.bus.emit(
+                        "fault_injected", fault=f.kind, replica=replica,
+                        req_n=nth_submit,
+                    )
+                if f.kind in ("replica_crash", "replica_hang"):
+                    self._armed.setdefault(replica, []).append(f.kind)
+                elif f.kind == "slow_window":
+                    self._slow[replica] = (
+                        self._slow.get(replica, 0) + self.slow_ticks
+                    )
+                else:  # reject_storm
+                    self._storm[replica] = (
+                        self._storm.get(replica, 0) + self.storm_rejects
+                    )
+
+    def should_reject(self, replica: int) -> bool:
+        """Consume one reject_storm token for this replica (submit path)."""
+        with self._lock:
+            left = self._storm.get(replica, 0)
+            if left <= 0:
+                return False
+            self._storm[replica] = left - 1
+            return True
+
+    def wrap_tick(self, replica: int, tick: Any) -> Any:
+        """Shim for ``engine.pipeline_tick``: checks armed actions before
+        delegating. Installed as an instance attribute on the engine (the
+        same shadowing trick the throttle tests use), so the engine class
+        stays untouched."""
+
+        def _tick(*a: Any, **kw: Any) -> Any:
+            with self._lock:
+                armed = self._armed.get(replica, [])
+                action = armed.pop(0) if armed else None
+                slow = self._slow.get(replica, 0)
+                if action is None and slow > 0:
+                    self._slow[replica] = slow - 1
+            if action == "replica_crash":
+                raise InjectedFault(f"injected replica_crash on replica {replica}")
+            if action == "replica_hang":
+                time.sleep(_HANG_SECONDS)
+            elif action is None and slow > 0:
+                time.sleep(self.slow_s)
+            return tick(*a, **kw)
+
+        return _tick
 
 
 def truncate_leaf(ckpt_path: str, leaf: Optional[str] = None) -> Optional[str]:

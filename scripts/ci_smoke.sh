@@ -204,6 +204,21 @@ assert "pllm_serving_completed" in text, text[:400]
 assert "pllm_serving_submitted" in text, text[:400]
 assert "pllm_serving_http_requests_total" in text, text[:400]
 
+# Readiness is distinct from liveness: a draining loop keeps /healthz
+# green (the process is fine) but must drop out of the balancer.
+import urllib.error
+with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+    assert json.loads(r.read())["status"] == "ready"
+loop.begin_drain()
+try:
+    urllib.request.urlopen(f"{base}/readyz", timeout=30)
+    raise AssertionError("/readyz must 503 while draining")
+except urllib.error.HTTPError as e:
+    assert e.code == 503, e.code
+    assert json.loads(e.read())["status"] == "not-ready"
+with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+    assert json.loads(r.read())["status"] == "ok"
+
 gw.stop(); loop.stop()
 print(f"gateway smoke ok: {m}")
 EOF
@@ -378,3 +393,97 @@ python scripts/obs_report.py --capacity --strict \
     "$OBS_TMP/capacity_events.jsonl" > "$OBS_TMP/capacity_report.out"
 grep -q "binding constraint:" "$OBS_TMP/capacity_report.out" || {
     echo "obs_report --capacity missing the binding constraint"; exit 1; }
+
+# Fleet gate: a 2-replica fleet behind real HTTP with an injected
+# replica_crash mid-burst. Every accepted request must reach a terminal
+# (zero lost), at least one must have been redriven to the survivor, the
+# crashed replica must relaunch, and the merged /metrics exposition must
+# stay lint-clean with per-replica labels. The event stream then has to
+# survive the offline fleet auditor with --strict (request conservation,
+# redrive attribution, recovery timing).
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, time, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+
+def make_engine():
+    return ServingEngine(params, cfg, max_batch=2, n_blocks=24, block_size=8,
+                         temperature=0.0, steps_per_sched=4, pipeline_depth=2)
+
+bus = EventBus(os.path.join(tmp, "fleet_events.jsonl"))
+faults = ServingFaultInjector("replica_crash@req2:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_")
+replicas = [
+    Replica(i, make_engine, bus=bus, fault_injector=faults,
+            admission_factory=lambda reg: AdmissionController(
+                max_queue_depth=8, registry=reg))
+    for i in range(2)
+]
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=0.2).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+spec = LoadSpec(n_requests=12, mode="closed", concurrency=4, seed=9,
+                vocab_size=cfg.vocab_size, max_new_min=6, max_new_max=10)
+report = run_http(base, spec)
+
+lost = spec.n_requests - len(report.outcomes)
+assert lost == 0, f"{lost} requests lost"
+statuses = {}
+for o in report.outcomes:
+    statuses[o.status] = statuses.get(o.status, 0) + 1
+assert statuses == {"done": 12}, statuses
+summary = report.summary()
+assert summary["redrives_total"] >= 1, summary
+assert router.counters["ejects"] >= 1, router.counters
+
+# The crashed replica must come back (backoff relaunch) before we stop.
+deadline = time.monotonic() + 10.0
+while time.monotonic() < deadline:
+    if all(rep.accepting for rep in router.replicas):
+        break
+    time.sleep(0.05)
+assert router.replicas[0].generation >= 2, router.replicas[0].debug_snapshot()
+
+with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+    assert json.loads(r.read())["status"] == "ready"
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_redrives_total" in text, text[:400]
+assert 'replica="0"' in text and 'replica="1"' in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"fleet smoke ok: {statuses}, "
+      f"redrives={router.counters['redrives']}, "
+      f"ejects={router.counters['ejects']}")
+EOF
+
+# The fleet auditor must accept the drill with --strict: conservation
+# (every fleet submit reaches exactly one terminal), redrives joined to
+# known requests, and a measured recovery for the ejected replica.
+python scripts/obs_report.py --fleet --strict \
+    "$OBS_TMP/fleet_events.jsonl" > "$OBS_TMP/fleet_report.out"
+grep -q "lost=0" "$OBS_TMP/fleet_report.out" || {
+    echo "obs_report --fleet did not report lost=0"; exit 1; }
+grep -q "redrive cost" "$OBS_TMP/fleet_report.out" || {
+    echo "obs_report --fleet missing the redrive cost section"; exit 1; }

@@ -36,6 +36,17 @@ shed) is joined to its trace so "why was trace X preempted" is
 answerable offline. ``--strict`` makes a >1% sum error or an unjoinable
 decision fatal, which is the CI capacity gate.
 
+``--fleet`` adds the ROUTER view: the ``fleet_req_submit`` /
+``fleet_req_terminal`` streams are joined by ``frid`` to assert request
+conservation (every accepted request reaches exactly one terminal — the
+zero-lost invariant a replica-crash drill is checking), the
+replica-tagged ``req_*`` streams become per-replica waterfalls,
+``redrive`` events are folded into failover cost (requests redriven,
+committed tokens carried over, e2e penalty vs. undisturbed), and
+``replica_state`` transitions into per-incident recovery times. Under
+``--strict`` a lost request or dangling redrive is fatal, which is the
+CI fleet gate.
+
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
 """
@@ -638,6 +649,218 @@ def print_capacity_report(report: Dict[str, Any]) -> None:
         print(f"!! {p}")
 
 
+# -- fleet attribution (--fleet) --------------------------------------------
+
+
+def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the fleet event streams into the router view:
+
+      conservation  every ``fleet_req_submit`` frid must reach exactly one
+                    ``fleet_req_terminal`` — the zero-lost-requests
+                    invariant a crash/drain drill is asserting (strict);
+      per-replica   request waterfalls from the replica-tagged ``req_*``
+                    streams: what each replica accepted, finished, failed
+                    (its loop died mid-decode) — failure here is NORMAL
+                    fleet operation as long as conservation holds;
+      redrive cost  how many requests failed over, the committed-token
+                    frontier they carried (tokens NOT regenerated), and
+                    the e2e penalty vs. undisturbed requests;
+      recovery      per-replica lifecycle from ``replica_state`` events:
+                    active -> ejected/draining -> active, with the
+                    out-of-service interval measured from the bus clock.
+    """
+    submits = [e for e in events if e.get("event") == "fleet_req_submit"]
+    terms = [e for e in events if e.get("event") == "fleet_req_terminal"]
+    redrives = [e for e in events if e.get("event") == "redrive"]
+    states = [e for e in events if e.get("event") == "replica_state"]
+    brownouts = [e for e in events if e.get("event") == "brownout"]
+
+    problems: List[str] = []
+    sub_frids: Dict[Any, Dict[str, Any]] = {}
+    for e in submits:
+        frid = e.get("frid")
+        if frid in sub_frids:
+            problems.append(f"duplicate fleet_req_submit for frid {frid}")
+        sub_frids[frid] = e
+    term_frids: Dict[Any, Dict[str, Any]] = {}
+    for e in terms:
+        frid = e.get("frid")
+        if frid in term_frids:
+            problems.append(f"duplicate fleet_req_terminal for frid {frid}")
+        term_frids[frid] = e
+    lost = sorted(set(sub_frids) - set(term_frids))
+    for frid in lost:
+        problems.append(
+            f"LOST request: frid {frid} submitted but never reached a "
+            f"terminal (conservation violated)"
+        )
+    for frid in sorted(set(term_frids) - set(sub_frids)):
+        problems.append(
+            f"orphan fleet_req_terminal for frid {frid} (no submit)"
+        )
+    for e in redrives:
+        if e.get("frid") not in sub_frids:
+            problems.append(
+                f"redrive references unknown frid {e.get('frid')}"
+            )
+
+    status_counts: Dict[str, int] = {}
+    for e in terms:
+        s = str(e.get("status", "?"))
+        status_counts[s] = status_counts.get(s, 0) + 1
+
+    # Per-replica waterfalls from the replica-tagged EngineLoop streams.
+    per_replica: Dict[int, Dict[str, int]] = {}
+
+    def _rep_slot(r: Any) -> Dict[str, int]:
+        return per_replica.setdefault(
+            int(r),
+            {"submits": 0, "done": 0, "errors": 0, "expired": 0,
+             "cancelled": 0, "tokens": 0, "redrives_in": 0,
+             "redrives_out": 0},
+        )
+
+    for e in events:
+        r = e.get("replica")
+        if r is None:
+            continue
+        kind = e.get("event")
+        if kind == "req_submit":
+            _rep_slot(r)["submits"] += 1
+        elif kind == "req_done":
+            slot = _rep_slot(r)
+            slot["done"] += 1
+            slot["tokens"] += int(e.get("n_tokens", 0))
+        elif kind == "req_error":
+            _rep_slot(r)["errors"] += 1
+        elif kind == "req_expired":
+            _rep_slot(r)["expired"] += 1
+        elif kind == "req_cancelled":
+            _rep_slot(r)["cancelled"] += 1
+    for e in redrives:
+        if e.get("from_replica") is not None:
+            _rep_slot(e["from_replica"])["redrives_out"] += 1
+        if e.get("to_replica") is not None:
+            _rep_slot(e["to_replica"])["redrives_in"] += 1
+
+    # Redrive cost: the committed frontier carried over is decode work the
+    # failover did NOT repeat; the e2e delta vs undisturbed is what it cost.
+    redriven_e2e = sorted(
+        float(e["e2e_s"]) for e in terms
+        if int(e.get("redrives", 0)) > 0 and e.get("e2e_s") is not None
+    )
+    clean_e2e = sorted(
+        float(e["e2e_s"]) for e in terms
+        if int(e.get("redrives", 0)) == 0 and e.get("e2e_s") is not None
+    )
+    redrive_cost = {
+        "redriven_requests": sum(
+            1 for e in terms if int(e.get("redrives", 0)) > 0
+        ),
+        "redrive_events": len(redrives),
+        "tokens_carried_over": sum(
+            int(e.get("n_committed", 0)) for e in redrives
+        ),
+        "reasons": {},
+        "e2e_p50_redriven_s": _percentile(redriven_e2e, 0.50),
+        "e2e_p50_clean_s": _percentile(clean_e2e, 0.50),
+    }
+    for e in redrives:
+        rs = str(e.get("reason", "?"))
+        redrive_cost["reasons"][rs] = redrive_cost["reasons"].get(rs, 0) + 1
+
+    # Recovery: replica_state transitions, out-of-service span per incident.
+    lifecycle: Dict[int, List[Dict[str, Any]]] = {}
+    for e in sorted(states, key=lambda e: float(e.get("t_mono", 0.0))):
+        lifecycle.setdefault(int(e.get("replica", -1)), []).append({
+            "t_mono": float(e.get("t_mono", 0.0)),
+            "state": e.get("state"),
+            "reason": e.get("reason"),
+            "generation": e.get("generation"),
+        })
+    incidents: List[Dict[str, Any]] = []
+    for rep, trail in lifecycle.items():
+        down_at: Optional[Dict[str, Any]] = None
+        for rec in trail:
+            if rec["state"] in ("ejected", "draining") and down_at is None:
+                down_at = rec
+            elif rec["state"] == "active" and down_at is not None:
+                incidents.append({
+                    "replica": rep,
+                    "kind": down_at["state"],
+                    "reason": down_at["reason"],
+                    "recovery_s": rec["t_mono"] - down_at["t_mono"],
+                })
+                down_at = None
+        if down_at is not None:
+            incidents.append({
+                "replica": rep,
+                "kind": down_at["state"],
+                "reason": down_at["reason"],
+                "recovery_s": None,  # still down at end of log
+            })
+
+    return {
+        "n_submitted": len(submits),
+        "n_terminal": len(terms),
+        "lost_requests": len(lost),
+        "statuses": status_counts,
+        "per_replica": {str(k): v for k, v in sorted(per_replica.items())},
+        "redrive_cost": redrive_cost,
+        "incidents": incidents,
+        "brownout_transitions": len(brownouts),
+        "problems": problems,
+    }
+
+
+def print_fleet_report(report: Dict[str, Any]) -> None:
+    print("== fleet ==")
+    print(
+        f"submitted={report['n_submitted']} terminal={report['n_terminal']} "
+        f"lost={report['lost_requests']} statuses={report['statuses']}"
+    )
+    if report["per_replica"]:
+        print("== per-replica waterfall ==")
+        hdr = ("replica", "submits", "done", "errors", "expired",
+               "tokens", "rd_out", "rd_in")
+        print("  " + " ".join(f"{h:>8}" for h in hdr))
+        for rep, row in report["per_replica"].items():
+            print("  " + " ".join(f"{v:>8}" for v in (
+                rep, row["submits"], row["done"], row["errors"],
+                row["expired"], row["tokens"], row["redrives_out"],
+                row["redrives_in"],
+            )))
+    rc = report["redrive_cost"]
+    if rc["redrive_events"]:
+        print("== redrive cost ==")
+        print(
+            f"requests_redriven={rc['redriven_requests']} "
+            f"events={rc['redrive_events']} "
+            f"tokens_carried_over={rc['tokens_carried_over']}"
+        )
+        print(
+            f"e2e_p50 redriven={rc['e2e_p50_redriven_s']:.4f}s "
+            f"vs clean={rc['e2e_p50_clean_s']:.4f}s"
+        )
+        for reason, n in sorted(rc["reasons"].items()):
+            print(f"  {reason:<40} {n}")
+    if report["incidents"]:
+        print("== replica incidents ==")
+        for inc in report["incidents"]:
+            rec = (
+                f"{inc['recovery_s']:.3f}s"
+                if inc["recovery_s"] is not None else "STILL DOWN"
+            )
+            print(
+                f"  replica {inc['replica']}: {inc['kind']} "
+                f"({inc['reason']}) -> recovered in {rec}"
+            )
+    if report["brownout_transitions"]:
+        print(f"brownout transitions: {report['brownout_transitions']}")
+    for p in report["problems"]:
+        print(f"!! {p}")
+
+
 def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     events, metrics = split_records(records)
     counts: Dict[str, int] = {}
@@ -743,11 +966,20 @@ def main() -> int:
         "decision-to-trace join; --strict makes a >1% sum error, an "
         "unjoinable decision, or a run with no occupancy samples fatal",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="fleet attribution from fleet_req_*/redrive/replica_state "
+        "events: request conservation (every submit reaches a terminal), "
+        "per-replica waterfalls, redrive cost, replica recovery time; "
+        "--strict makes a lost request or a dangling redrive fatal",
+    )
     args = parser.parse_args()
     if args.slo and not args.trace:
         parser.error("--slo needs --trace")
     if args.capacity and not args.paths:
         parser.error("--capacity needs events JSONL paths")
+    if args.fleet and not args.paths:
+        parser.error("--fleet needs events JSONL paths")
     if not args.paths and not args.trace:
         parser.error("nothing to analyze: pass JSONL paths and/or --trace")
 
@@ -771,6 +1003,11 @@ def main() -> int:
         events, _ = split_records(records)
         cap_report = build_capacity_report(events)
         report["capacity"] = cap_report
+    fleet_report: Optional[Dict[str, Any]] = None
+    if args.fleet:
+        events, _ = split_records(records)
+        fleet_report = build_fleet_report(events)
+        report["fleet"] = fleet_report
     if args.json:
         print(json.dumps(report, indent=2, allow_nan=False))
     else:
@@ -780,6 +1017,8 @@ def main() -> int:
             print_slo_report(slo_report)
         if cap_report is not None:
             print_capacity_report(cap_report)
+        if fleet_report is not None:
+            print_fleet_report(fleet_report)
         if bad:
             print(f"!! {bad} unparseable line(s)", file=sys.stderr)
         if slo_report is not None and slo_report["dropped_spans"]:
@@ -797,6 +1036,10 @@ def main() -> int:
         return 1
     if args.strict and cap_report is not None and cap_report["problems"]:
         for p in cap_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
+        return 1
+    if args.strict and fleet_report is not None and fleet_report["problems"]:
+        for p in fleet_report["problems"]:
             print(f"STRICT: {p}", file=sys.stderr)
         return 1
     return 0

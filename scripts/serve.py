@@ -122,6 +122,20 @@ def main() -> None:
                         help="(--http) /healthz returns 503 once the engine "
                         "loop has not completed a scheduler turn for this "
                         "many seconds; 0 = disabled (default: config)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="(--http) in-process engine replicas behind the "
+                        "fleet router: prefix-affinity routing, health "
+                        "ejection + relaunch, drain/redrive of in-flight "
+                        "requests. 1 = plain single engine loop (default: "
+                        "config)")
+    parser.add_argument("--serving_faults", default=None,
+                        help="(--http) serving fault plan, e.g. "
+                        "'replica_crash@req3:r0,slow_window@req5' — a "
+                        "deterministic failover drill (default: config)")
+    parser.add_argument("--wedged_after_s", type=float, default=None,
+                        help="(--http) watchdog: eject a replica whose loop "
+                        "has active requests but no completed scheduler turn "
+                        "for this long; 0 = disabled (default: config)")
     args = parser.parse_args()
     if not args.http and not args.input_file:
         parser.error("--input_file is required unless --http is set")
@@ -151,25 +165,31 @@ def main() -> None:
             draft_cfg=d_cfg.model, spec_k=args.spec_k,
         )
 
-    eng = ServingEngine(
-        params, cfg.model,
-        max_batch=args.max_batch, n_blocks=args.n_blocks,
-        block_size=args.block_size, temperature=args.temperature,
-        top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
-        stop_token=args.stop_token, seed=args.seed,
-        steps_per_sched=args.steps_per_sched,
-        pipeline_depth=args.pipeline_depth or cfg.serving.pipeline_depth,
-        admit_batch=args.admit_batch or cfg.serving.admit_batch,
-        prefix_cache=args.prefix_cache or cfg.serving.prefix_cache,
-        prefix_cache_min_blocks=(
-            args.prefix_cache_min_blocks or cfg.serving.prefix_cache_min_blocks
-        ),
-        **spec,
-    )
+    # A factory, not an engine: the fleet path builds one engine per
+    # replica, and a crashed replica relaunches with a FRESH engine.
+    def make_engine():
+        return ServingEngine(
+            params, cfg.model,
+            max_batch=args.max_batch, n_blocks=args.n_blocks,
+            block_size=args.block_size, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
+            stop_token=args.stop_token, seed=args.seed,
+            steps_per_sched=args.steps_per_sched,
+            pipeline_depth=args.pipeline_depth or cfg.serving.pipeline_depth,
+            admit_batch=args.admit_batch or cfg.serving.admit_batch,
+            prefix_cache=args.prefix_cache or cfg.serving.prefix_cache,
+            prefix_cache_min_blocks=(
+                args.prefix_cache_min_blocks
+                or cfg.serving.prefix_cache_min_blocks
+            ),
+            **spec,
+        )
 
     if args.http:
-        _serve_http(args, cfg, eng, enc)
+        _serve_http(args, cfg, make_engine, enc)
         return
+
+    eng = make_engine()
 
     rids = {}
     rejected = []
@@ -213,15 +233,25 @@ def main() -> None:
     )
 
 
-def _serve_http(args, cfg, eng, enc) -> None:
-    """Run the online gateway until interrupted (Ctrl-C)."""
+def _serve_http(args, cfg, make_engine, enc) -> None:
+    """Run the online gateway until interrupted (Ctrl-C).
+
+    ``--replicas 1`` (the default) keeps the original single
+    EngineLoop wiring; ``--replicas N`` puts the fleet Router in front
+    of N in-process replicas (each with its own engine, loop, admission
+    and labeled registry) — same gateway, same endpoints, plus
+    failover/drain/redrive semantics.
+    """
     from pretraining_llm_tpu.frontend.admission import AdmissionController
     from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
     from pretraining_llm_tpu.frontend.gateway import ServingGateway
+    from pretraining_llm_tpu.frontend.replica import Replica
+    from pretraining_llm_tpu.frontend.router import Router
     from pretraining_llm_tpu.observability.events import EventBus
     from pretraining_llm_tpu.observability.metrics import MetricsRegistry
     from pretraining_llm_tpu.observability.spans import get_recorder
     from pretraining_llm_tpu.observability.tracing import Tracer
+    from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
 
     fc = cfg.frontend
 
@@ -237,19 +267,60 @@ def _serve_http(args, cfg, eng, enc) -> None:
     if trace_sample > 0:
         tracer = Tracer(get_recorder(), sample=trace_sample, seed=args.seed)
     registry = MetricsRegistry(prefix="pllm_serving_")
-    admission = AdmissionController(
-        max_queue_depth=pick(args.max_queue_depth, fc.max_queue_depth),
-        max_outstanding_tokens=pick(
-            args.max_outstanding_tokens, fc.max_outstanding_tokens
-        ),
-        retry_after_s=fc.retry_after_s,
-        shed_infeasible=fc.shed_infeasible,
-        registry=registry,
+    n_replicas = pick(args.replicas, fc.replicas)
+    fault_spec = pick(args.serving_faults, fc.serving_faults)
+    faults = (
+        ServingFaultInjector(fault_spec, bus=bus) if fault_spec else None
     )
-    loop = EngineLoop(
-        eng, admission=admission, bus=bus, idle_wait_s=fc.idle_wait_s,
-        tracer=tracer, registry=registry, capacity_ring=fc.capacity_ring,
-    ).start()
+    max_queue_depth = pick(args.max_queue_depth, fc.max_queue_depth)
+    max_outstanding = pick(
+        args.max_outstanding_tokens, fc.max_outstanding_tokens
+    )
+
+    def make_admission(reg, scope=""):
+        return AdmissionController(
+            max_queue_depth=max_queue_depth,
+            max_outstanding_tokens=max_outstanding,
+            retry_after_s=fc.retry_after_s,
+            shed_infeasible=fc.shed_infeasible,
+            registry=reg,
+            scope=scope,
+        )
+
+    if n_replicas > 1:
+        replicas = [
+            Replica(
+                i, make_engine, bus=bus, tracer=tracer,
+                admission_factory=make_admission, fault_injector=faults,
+                loop_kwargs=dict(
+                    idle_wait_s=fc.idle_wait_s, capacity_ring=fc.capacity_ring,
+                ),
+            )
+            for i in range(n_replicas)
+        ]
+        loop = Router(
+            replicas,
+            admission=make_admission(registry, scope="fleet"),
+            bus=bus, registry=registry, tracer=tracer,
+            affinity_tokens=fc.affinity_tokens,
+            spill_margin=fc.spill_margin,
+            wedged_after_s=pick(args.wedged_after_s, fc.wedged_after_s),
+            eject_backoff_s=fc.eject_backoff_s,
+            eject_backoff_max_s=fc.eject_backoff_max_s,
+            redrive_max=fc.redrive_max,
+            brownout_min_healthy_frac=fc.brownout_min_healthy_frac,
+            brownout_min_priority=fc.brownout_min_priority,
+            brownout_max_deadline_s=fc.brownout_max_deadline_s,
+        ).start()
+    else:
+        eng = make_engine()
+        if faults is not None:
+            eng.pipeline_tick = faults.wrap_tick(0, eng.pipeline_tick)
+        loop = EngineLoop(
+            eng, admission=make_admission(registry), bus=bus,
+            idle_wait_s=fc.idle_wait_s, tracer=tracer, registry=registry,
+            capacity_ring=fc.capacity_ring,
+        ).start()
     gateway = ServingGateway(
         loop,
         host=pick(args.host, fc.host),
@@ -260,11 +331,15 @@ def _serve_http(args, cfg, eng, enc) -> None:
         healthz_stale_after_s=pick(
             args.healthz_stale_after_s, fc.healthz_stale_after_s
         ),
+        retry_jitter_frac=fc.retry_jitter_frac,
+        retry_jitter_seed=fc.retry_jitter_seed,
     )
+    fleet = f" ({n_replicas} replicas)" if n_replicas > 1 else ""
     print(
-        f"[serve] gateway listening on http://{gateway._server.server_address[0]}"
-        f":{gateway.port} — POST /v1/generate, GET /healthz, GET /metrics, "
-        f"GET /debug/requests, GET /debug/engine",
+        f"[serve] gateway{fleet} listening on "
+        f"http://{gateway._server.server_address[0]}"
+        f":{gateway.port} — POST /v1/generate, GET /healthz, GET /readyz, "
+        f"GET /metrics, GET /debug/requests, GET /debug/engine",
         file=sys.stderr,
     )
     # SIGTERM (a plain `kill`, the orchestrator's stop signal) must take
@@ -280,7 +355,11 @@ def _serve_http(args, cfg, eng, enc) -> None:
         pass
     finally:
         gateway.stop()
-        loop.stop()
+        clean = loop.stop()
+        if clean is False:
+            print("[serve] WARNING: engine loop abandoned wedged at "
+                  "shutdown; outstanding requests got error terminals",
+                  file=sys.stderr)
         if bus is not None:
             bus.close()
         if tracer is not None and trace_path:
@@ -288,7 +367,8 @@ def _serve_http(args, cfg, eng, enc) -> None:
             dropped = tracer.recorder.dropped
             extra = f" ({dropped} spans DROPPED)" if dropped else ""
             print(f"[serve] trace written to {path}{extra}", file=sys.stderr)
-        print(f"[serve] shut down — {loop.counters}", file=sys.stderr)
+        counters = getattr(loop, "counters", {})
+        print(f"[serve] shut down — {counters}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -109,6 +109,8 @@ def test_capacity_event_kinds_documented():
     assert set(DECISION_KINDS) == {
         "reject_busy", "reject_infeasible", "preempt", "evict_cold",
         "reclaim_spec", "expire_inflight",
+        # fleet tier (frontend/router.py)
+        "eject_replica", "redrive", "brownout_shed",
     }
 
 

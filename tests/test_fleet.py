@@ -1,0 +1,657 @@
+"""Multi-replica serving fleet: router tier, serving-path fault
+injection, and drain/redrive of in-flight requests.
+
+The correctness bar extends the frontend tests' contract across replica
+failure: a request redriven to a survivor (after a crash, hang, or
+administrative drain of its replica) must resume from its committed
+token frontier and finish with greedy output BIT-IDENTICAL to a run
+that never saw the disturbance — at every pipeline depth, prefix cache
+on or off — with the survivor's allocator accounting matching an
+undisturbed engine and zero requests lost.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import FrontendConfig, get_preset
+from pretraining_llm_tpu.frontend.admission import (
+    AdmissionController,
+    RejectedBusy,
+)
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import (
+    FleetAction,
+    LoadSpec,
+    build_schedule,
+    rolling_restart_plan,
+)
+from pretraining_llm_tpu.frontend.replica import Replica, ReplicaUnavailable
+from pretraining_llm_tpu.frontend.router import Router, prefix_digest
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import (
+    MetricsRegistry,
+    render_merged,
+)
+from pretraining_llm_tpu.resilience.faults import (
+    InjectedFault,
+    ServingFault,
+    ServingFaultInjector,
+    parse_serving_faults,
+)
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+
+# The offline analyzer doubles as the fleet-report checker: import it as
+# a module so tests assert with EXACTLY the logic the CI gate runs.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_fleet", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)])).tolist()
+        for i in range(n)
+    ]
+
+
+def _engine_factory(params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_blocks", 24)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("steps_per_sched", 4)
+    kw.setdefault("pipeline_depth", 2)
+
+    def factory():
+        return ServingEngine(params, CFG, temperature=0.0, **kw)
+
+    return factory
+
+
+def _undisturbed(params, prompts, n_new, **kw):
+    """Reference outputs: one engine, no fleet, no faults. Greedy decode
+    is bit-identical across batch/scheduling config, so this is THE
+    answer any disturbed fleet run must reproduce."""
+    eng = _engine_factory(params, **kw)()
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return {rids[rid]: toks for rid, toks in out.items()}
+
+
+def _fleet(params, n=2, faults=None, bus=None, engine_kw=None, **router_kw):
+    factory = _engine_factory(params, **(engine_kw or {}))
+    reps = [
+        Replica(i, factory, bus=bus, fault_injector=faults)
+        for i in range(n)
+    ]
+    router_kw.setdefault("eject_backoff_s", 0.1)
+    return Router(reps, bus=bus, **router_kw)
+
+
+# -- fault-plan parsing -----------------------------------------------------
+
+
+def test_parse_serving_faults():
+    plan = parse_serving_faults(
+        "replica_crash@req2:r0, slow_window@req5, reject_storm@req1:r1"
+    )
+    assert plan == [
+        ServingFault("replica_crash", 2, 0),
+        ServingFault("slow_window", 5, None),
+        ServingFault("reject_storm", 1, 1),
+    ]
+    with pytest.raises(ValueError, match="empty serving fault plan"):
+        parse_serving_faults("")
+    with pytest.raises(ValueError, match="unknown serving fault"):
+        parse_serving_faults("chaos@req1")
+    with pytest.raises(ValueError, match="req"):
+        parse_serving_faults("replica_crash@2")
+    with pytest.raises(ValueError, match="replica"):
+        parse_serving_faults("replica_crash@req2:rX")
+
+
+# -- redrive bit-identity (satellite 4) -------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("cache", [False, True], ids=["nocache", "cache"])
+def test_redrive_bit_identity_after_crash(params, depth, cache):
+    """Crash a replica with requests mid-decode: every request fails over
+    to the survivor, resumes from its committed frontier, and its final
+    greedy output is bit-identical to a run that never crashed — at every
+    pipeline depth, prefix cache on and off."""
+    prompts = _prompts(6)
+    n_new = 8
+    kw = dict(pipeline_depth=depth, prefix_cache=cache)
+    ref = _undisturbed(params, prompts, n_new, **kw)
+
+    faults = ServingFaultInjector("replica_crash@req2:r0")
+    router = _fleet(params, faults=faults, engine_kw=kw)
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], f"request {i} diverged after redrive"
+    assert router.counters["redrives"] >= 1
+    assert router.counters["ejects"] == 1
+    assert sum(1 for _, _, inf in results if inf["redrives"] > 0) >= 1
+
+
+def test_redrive_preserves_committed_frontier(params):
+    """A redriven request does NOT regenerate tokens it already streamed:
+    the committed frontier before the crash is a prefix of the final
+    output (the continuation decodes only the remainder)."""
+    prompts = _prompts(4)
+    n_new = 10
+    ref = _undisturbed(params, prompts, n_new)
+    faults = ServingFaultInjector("replica_crash@req2:r0", slow_ticks=0)
+    router = _fleet(params, faults=faults)
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done"
+        assert tokens == ref[i]
+        assert len(tokens) == n_new
+
+
+def test_survivor_allocator_matches_undisturbed(params):
+    """After the drill settles, the survivor's allocator must hold
+    exactly the blocks an undisturbed engine would (all freed), and the
+    relaunched replica's fresh engine starts with a full pool — a crash
+    must not leak pages anywhere in the fleet."""
+    prompts = _prompts(5)
+    faults = ServingFaultInjector("replica_crash@req2:r0")
+    router = _fleet(params, faults=faults)
+    with router:
+        reqs = [router.submit(p, 8) for p in prompts]
+        for r in reqs:
+            status, _, _ = r.result(timeout=120)
+            assert status == "done"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(rep.accepting for rep in router.replicas):
+                break
+            time.sleep(0.05)
+        for rep in router.replicas:
+            assert rep.accepting, rep.debug_snapshot()
+            # block 0 is reserved; everything else must be back.
+            assert rep.engine.alloc.available == 24 - 1, rep.index
+        assert router.replicas[0].generation == 2  # relaunched once
+
+
+# -- drain / rolling restart ------------------------------------------------
+
+
+def test_drain_redrives_inflight_and_restore(params):
+    """Administrative drain mid-decode: the drained replica's in-flight
+    requests fail over and finish bit-identical; the replica refuses new
+    work until restore() brings it back with a fresh engine."""
+    prompts = _prompts(4)
+    n_new = 12
+    ref = _undisturbed(params, prompts, n_new)
+    router = _fleet(params)
+    with router:
+        # Slow both engines down so requests are reliably mid-decode.
+        for rep in router.replicas:
+            orig = rep.engine.pipeline_tick
+
+            def slow(orig=orig):
+                time.sleep(0.03)
+                return orig()
+
+            rep.engine.pipeline_tick = slow
+        reqs = [router.submit(p, n_new) for p in prompts]
+        time.sleep(0.08)  # let decode start
+        victim = next(
+            (rr.replica for rr in reqs if rr.replica is not None), 0
+        )
+        router.drain(victim)
+        rep = router.replicas[victim]
+        assert rep.state == "draining"
+        with pytest.raises(ReplicaUnavailable):
+            rep.submit([1, 2, 3], 4)
+        results = [r.result(timeout=120) for r in reqs]
+        for i, (status, tokens, _) in enumerate(results):
+            assert status == "done"
+            assert tokens == ref[i]
+        router.restore(victim)
+        assert rep.state == "active"
+        assert rep.generation == 2
+        status, tokens, _ = router.submit([1, 2, 3], 4).result(timeout=120)
+        assert status == "done"
+
+
+def test_rolling_restart_plan_shape():
+    plan = rolling_restart_plan(3, start_s=1.0, step_s=0.5)
+    assert [a.kind for a in plan] == ["drain", "restore"] * 3
+    assert plan[0].at_s == 1.0 and plan[1].at_s == 1.5
+    assert plan[4] == FleetAction(at_s=2.0, kind="drain", replica=2)
+    with pytest.raises(ValueError, match="unknown fleet action"):
+        FleetAction(at_s=0.0, kind="reboot", replica=0)
+    with pytest.raises(ValueError, match="at_s"):
+        FleetAction(at_s=-1.0, kind="kill", replica=0)
+
+
+# -- watchdog: hang detection ----------------------------------------------
+
+
+def test_hang_watchdog_ejects_and_redrives(params):
+    """replica_hang wedges the loop thread inside one scheduler turn; the
+    router's watchdog sees last_turn_age_s grow with requests active,
+    ejects the replica, and redrives — clients never notice beyond
+    latency."""
+    prompts = _prompts(4)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new)
+    faults = ServingFaultInjector("replica_hang@req2:r0")
+    router = _fleet(
+        params, faults=faults, wedged_after_s=0.3, health_interval_s=0.02
+    )
+    try:
+        router.start()
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        for i, (status, tokens, _) in enumerate(results):
+            assert status == "done"
+            assert tokens == ref[i]
+        assert router.counters["ejects"] >= 1
+        assert router.counters["redrives"] >= 1
+    finally:
+        # The hung daemon thread cannot join; don't wait for it.
+        router.stop(timeout=0.5)
+
+
+# -- reject_storm spills to peers -------------------------------------------
+
+
+def test_reject_storm_spills_to_peer(params):
+    """A replica in an injected 429 storm refuses submissions; the router
+    walks to the next candidate, so every request still completes."""
+    prompts = _prompts(6)
+    faults = ServingFaultInjector("reject_storm@req1:r0", storm_rejects=3)
+    router = _fleet(params, faults=faults)
+    with router:
+        reqs = [router.submit(p, 6) for p in prompts]
+        for r in reqs:
+            status, _, _ = r.result(timeout=120)
+            assert status == "done"
+        # The storm consumed 3 rejects on replica 0; the spilled requests
+        # landed on replica 1.
+        assert router.replicas[1].submits >= 3
+
+
+def test_slow_window_fault_completes(params):
+    """slow_window stretches scheduler turns without killing anything:
+    results stay bit-identical, no ejects with the watchdog off."""
+    prompts = _prompts(3)
+    ref = _undisturbed(params, prompts, 6)
+    faults = ServingFaultInjector("slow_window@req1:r0", slow_ticks=2, slow_s=0.02)
+    router = _fleet(params, faults=faults)
+    with router:
+        reqs = [router.submit(p, 6) for p in prompts]
+        for i, r in enumerate(reqs):
+            status, tokens, _ = r.result(timeout=120)
+            assert status == "done"
+            assert tokens == ref[i]
+    assert router.counters["ejects"] == 0
+
+
+# -- brownout ---------------------------------------------------------------
+
+
+def test_brownout_sheds_low_priority(params):
+    """With half the fleet down and brownout armed, priority-0 requests
+    are shed with 429 while priority-1 requests still pass."""
+    router = _fleet(
+        params,
+        brownout_min_healthy_frac=0.6,
+        brownout_min_priority=1,
+        health_interval_s=0.02,
+    )
+    with router:
+        router.drain(1)  # healthy 1/2 < 0.6 -> brownout
+        deadline = time.monotonic() + 5.0
+        while not router.brownout_active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert router.brownout_active
+        with pytest.raises(RejectedBusy, match="brownout"):
+            router.submit([1, 2, 3], 4, priority=0)
+        status, _, _ = router.submit(
+            [1, 2, 3], 4, priority=1
+        ).result(timeout=120)
+        assert status == "done"
+        assert router.counters["brownout_shed"] == 1
+        router.restore(1)
+        while router.brownout_active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not router.brownout_active
+        status, _, _ = router.submit([1, 2, 3], 4, priority=0).result(timeout=120)
+        assert status == "done"
+
+
+# -- prefix affinity --------------------------------------------------------
+
+
+def test_prefix_affinity_stable_and_spills(params):
+    """Same prompt prefix -> same replica (rendezvous placement is a pure
+    function of the digest); load imbalance past spill_margin overrides
+    affinity instead of queueing behind a hot replica."""
+    digest = prefix_digest([1, 2, 3, 4, 5, 6], 4)
+    assert digest == prefix_digest([1, 2, 3, 4, 99, 99], 4)  # only the prefix
+    assert digest != prefix_digest([9, 2, 3, 4, 5, 6], 4)
+
+    router = _fleet(params, affinity_tokens=4, spill_margin=2)
+    with router:
+        hot = [7, 7, 7, 7]
+        first = router.submit(hot + [1], 4)
+        second = router.submit(hot + [2], 4)
+        assert first.replica == second.replica  # affinity held
+        for r in (first, second):
+            assert r.result(timeout=120)[0] == "done"
+
+
+# -- EngineLoop.stop timeout (satellite 1) ----------------------------------
+
+
+def test_stop_timeout_fails_outstanding_requests(params):
+    """stop(timeout=) expiring must not strand requests: outstanding ones
+    get error terminals from the stopping thread, the timeout is surfaced
+    as a RuntimeWarning AND the False return."""
+    eng = _engine_factory(params)()
+    started = threading.Event()
+
+    def wedged_tick(*a, **kw):
+        started.set()
+        time.sleep(60.0)
+        return False
+
+    eng.pipeline_tick = wedged_tick
+    loop = EngineLoop(eng)
+    loop.start()
+    req = loop.submit([1, 2, 3], 8)
+    assert started.wait(10.0)
+    with pytest.warns(RuntimeWarning, match="still alive"):
+        clean = loop.stop(timeout=0.2)
+    assert clean is False
+    status, tokens, info = req.result(timeout=5.0)
+    assert status == "error"
+    assert "shutdown timeout" in info["reason"]
+
+
+def test_stop_clean_returns_true(params):
+    loop = EngineLoop(_engine_factory(params)())
+    loop.start()
+    req = loop.submit([1, 2, 3], 4)
+    assert req.result(timeout=120)[0] == "done"
+    assert loop.stop() is True
+
+
+# -- /readyz vs /healthz (satellite 3) --------------------------------------
+
+
+def _get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_readyz_distinct_from_healthz(params):
+    """A draining loop is alive (healthz 200) but must not receive new
+    traffic (readyz 503) — the signal a rolling restart keys off."""
+    loop = EngineLoop(_engine_factory(params)())
+    gw = ServingGateway(loop, port=0)
+    loop.start()
+    gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        assert _get(base, "/healthz")[0] == 200
+        code, body = _get(base, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+        loop.begin_drain()
+        code, body = _get(base, "/readyz")
+        assert code == 503 and body["status"] == "not-ready"
+        assert body["draining"] is True
+        assert _get(base, "/healthz")[0] == 200  # liveness unaffected
+    finally:
+        gw.stop()
+        loop.stop()
+
+
+def test_readyz_router_fleet(params):
+    """Router readiness: ready while ANY replica accepts; draining the
+    whole fleet flips it."""
+    router = _fleet(params)
+    gw = ServingGateway(router, port=0)
+    router.start()
+    gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        code, body = _get(base, "/readyz")
+        assert code == 200
+        assert body["replicas"] == {"0": "active", "1": "active"}
+        router.drain(0)
+        assert _get(base, "/readyz")[0] == 200  # one survivor -> still ready
+        router.drain(1)
+        code, body = _get(base, "/readyz")
+        assert code == 503
+        # The fleet /metrics surface stays lintable through the gateway.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert lint_exposition(text) == []
+        assert 'replica="0"' in text and 'replica="1"' in text
+    finally:
+        gw.stop()
+        router.stop()
+
+
+# -- Retry-After jitter (satellite 2) ---------------------------------------
+
+
+def test_retry_after_jitter_deterministic_and_bounded(params):
+    loop = EngineLoop(_engine_factory(params)())
+    a = ServingGateway(loop, port=0, retry_jitter_frac=0.5, retry_jitter_seed=7)
+    b = ServingGateway(loop, port=0, retry_jitter_frac=0.5, retry_jitter_seed=7)
+    c = ServingGateway(loop, port=0, retry_jitter_frac=0.5, retry_jitter_seed=8)
+    seq_a = [a.retry_after_header(4.0) for _ in range(20)]
+    seq_b = [b.retry_after_header(4.0) for _ in range(20)]
+    seq_c = [c.retry_after_header(4.0) for _ in range(20)]
+    assert seq_a == seq_b           # same seed -> same jitter sequence
+    assert seq_a != seq_c           # different seed decorrelates
+    for v in seq_a:
+        n = int(v)                  # RFC 7231 delta-seconds: integral
+        assert 4 <= n <= 6          # [base, base*(1+frac)], rounded up
+    assert len(set(seq_a)) > 1      # it actually jitters
+    with pytest.raises(ValueError, match="retry_jitter_frac"):
+        ServingGateway(loop, port=0, retry_jitter_frac=1.5)
+    # Zero jitter degrades to the exact base (ceil'd, min 1s).
+    z = ServingGateway(loop, port=0, retry_jitter_frac=0.0)
+    assert z.retry_after_header(0.2) == "1"
+    assert z.retry_after_header(3.0) == "3"
+
+
+# -- typed fleet metrics ----------------------------------------------------
+
+
+def test_render_merged_one_vocabulary():
+    fleet = MetricsRegistry("pllm_serving_")
+    r0 = MetricsRegistry("pllm_serving_", const_labels={"replica": 0})
+    r1 = MetricsRegistry("pllm_serving_", const_labels={"replica": 1})
+    fleet.counter("redrives_total", "redrives").inc(2)
+    for reg in (r0, r1):
+        reg.counter("http_errors_total", "errors").inc(1)
+        reg.gauge("queue_depth", "depth").set(3)
+    text = render_merged([fleet, r0, r1], {"replicas_active": 2.0})
+    assert lint_exposition(text) == []
+    # One TYPE line per name even though two registries carry the series.
+    assert text.count("# TYPE pllm_serving_http_errors_total counter") == 1
+    assert 'pllm_serving_queue_depth{replica="0"} 3' in text
+    assert 'pllm_serving_queue_depth{replica="1"} 3' in text
+    assert "pllm_serving_replicas_active 2" in text
+    # Same name, conflicting kinds across registries must fail loudly.
+    bad = MetricsRegistry("pllm_serving_")
+    bad.gauge("http_errors_total", "oops")
+    with pytest.raises(ValueError, match="registered as"):
+        render_merged([r0, bad], None)
+
+
+def test_fleet_typed_metrics_after_drill(params):
+    faults = ServingFaultInjector("replica_crash@req2:r0")
+    registry = MetricsRegistry("pllm_serving_")
+    router = _fleet(params, faults=faults, registry=registry)
+    with router:
+        reqs = [router.submit(p, 6) for p in _prompts(5)]
+        for r in reqs:
+            assert r.result(timeout=120)[0] == "done"
+        text = router.render_metrics(router.metrics())
+    assert lint_exposition(text) == []
+    assert "pllm_serving_redrives_total" in text
+    assert "pllm_serving_replica_ejects_total 1" in text
+    assert 'pllm_serving_replica_state{replica="1"} 1' in text
+
+
+# -- loadgen fleet fields ---------------------------------------------------
+
+
+def test_loadspec_priority_rng_neutral():
+    base = build_schedule(LoadSpec(n_requests=12, seed=11))
+    off = build_schedule(LoadSpec(n_requests=12, seed=11, priority_hi_frac=0.0))
+    assert off == base  # frac=0 consumes no rng: schedules byte-identical
+    assert all(sr.priority == 0 for sr in base)
+    on = build_schedule(
+        LoadSpec(n_requests=12, seed=11, priority_hi_frac=0.5, priority_hi=2)
+    )
+    assert {sr.priority for sr in on} == {0, 2}
+    # Request 0's prompt draws precede its priority draw: unchanged.
+    assert on[0].prompt == base[0].prompt
+    with pytest.raises(ValueError, match="priority_hi_frac"):
+        LoadSpec(priority_hi_frac=1.5)
+
+
+def test_frontend_config_fleet_validation():
+    fc = FrontendConfig(replicas=3, serving_faults="replica_crash@req2:r0")
+    assert fc.replicas == 3
+    with pytest.raises(ValueError, match="replicas"):
+        FrontendConfig(replicas=0)
+    with pytest.raises(ValueError, match="spill_margin"):
+        FrontendConfig(spill_margin=0)
+    with pytest.raises(ValueError, match="eject_backoff_max_s"):
+        FrontendConfig(eject_backoff_s=2.0, eject_backoff_max_s=1.0)
+    with pytest.raises(ValueError, match="brownout_min_healthy_frac"):
+        FrontendConfig(brownout_min_healthy_frac=2.0)
+    with pytest.raises(ValueError, match="retry_jitter_frac"):
+        FrontendConfig(retry_jitter_frac=-0.1)
+
+
+# -- fleet observability: conservation + recovery (obs_report --fleet) ------
+
+
+def test_fleet_report_conservation_and_recovery(params, tmp_path):
+    """The crash drill's event stream must pass the CI fleet gate: every
+    submit reaches a terminal, redrives join to known frids, the eject
+    incident carries a measured recovery time — and REMOVING a terminal
+    makes the strict gate fail (the gate actually detects loss)."""
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(jsonl_path=str(path))
+    faults = ServingFaultInjector("replica_crash@req2:r0", bus=bus)
+    router = _fleet(params, faults=faults, bus=bus)
+    with router:
+        reqs = [router.submit(p, 8) for p in _prompts(6)]
+        for r in reqs:
+            assert r.result(timeout=120)[0] == "done"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(rep.accepting for rep in router.replicas):
+                break
+            time.sleep(0.05)
+    bus.close()
+
+    events = [json.loads(ln) for ln in path.read_text().splitlines()]
+    report = obs_report.build_fleet_report(events)
+    assert report["problems"] == []
+    assert report["lost_requests"] == 0
+    assert report["n_submitted"] == report["n_terminal"] == 6
+    assert report["statuses"] == {"done": 6}
+    assert report["redrive_cost"]["redrive_events"] >= 1
+    ejected = [
+        i for i in report["incidents"]
+        if i["kind"] == "ejected" and i["recovery_s"] is not None
+    ]
+    assert ejected and ejected[0]["replica"] == 0
+    assert ejected[0]["recovery_s"] > 0
+
+    # Drop one terminal: the conservation check must catch the loss.
+    term = next(e for e in events if e.get("event") == "fleet_req_terminal")
+    broken = obs_report.build_fleet_report([e for e in events if e is not term])
+    assert any("LOST" in p for p in broken["problems"])
+
+
+def test_injected_crash_is_attributable(params, tmp_path):
+    """fault_injected events carry the plan entry that fired, so a drill's
+    outcome is attributable to its cause in the same JSONL."""
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(jsonl_path=str(path))
+    faults = ServingFaultInjector("replica_crash@req2:r0", bus=bus)
+    router = _fleet(params, faults=faults, bus=bus)
+    with router:
+        reqs = [router.submit(p, 6) for p in _prompts(4)]
+        for r in reqs:
+            assert r.result(timeout=120)[0] == "done"
+    bus.close()
+    events = [json.loads(ln) for ln in path.read_text().splitlines()]
+    fired = [e for e in events if e.get("event") == "fault_injected"]
+    assert len(fired) == 1
+    assert fired[0]["fault"] == "replica_crash"
+    assert fired[0]["replica"] == 0
+    assert fired[0]["req_n"] == 2
+
+
+# -- router shutdown sweeps stragglers --------------------------------------
+
+
+def test_router_stop_terminates_live_requests(params):
+    """Stopping the fleet mid-decode must deliver SOME terminal to every
+    live request — the belt-and-suspenders sweep, not a client hang."""
+    router = _fleet(params)
+    router.start()
+    for rep in router.replicas:
+        orig = rep.engine.pipeline_tick
+
+        def slow(orig=orig):
+            time.sleep(0.05)
+            return orig()
+
+        rep.engine.pipeline_tick = slow
+    reqs = [router.submit(p, 50) for p in _prompts(4)]
+    time.sleep(0.1)
+    router.stop(timeout=5.0)
+    for r in reqs:
+        status, _, info = r.result(timeout=5.0)
+        assert status in ("done", "error")
+        if status == "error":
+            assert "shutdown" in info["reason"]
