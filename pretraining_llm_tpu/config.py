@@ -757,6 +757,15 @@ class ServingConfig:
     # Shortest cached prefix (in blocks) worth mapping — below this the
     # table-sharing bookkeeping outweighs the prefill saved.
     prefix_cache_min_blocks: int = 1
+    # Chunked prefill: split admitted prompts into chunks of at most this
+    # many tokens and stream them in alongside decode windows instead of
+    # running one monolithic prefill per admission. Caps how long any
+    # single prefill dispatch can stall in-flight decode rows, which is
+    # the dominant TTFT head-of-line term under long-prompt mixes. The
+    # same budget bounds total chunk tokens per scheduler tick, so decode
+    # TPOT is protected. 0 disables (monolithic prefill at admission);
+    # greedy outputs are bit-identical either way.
+    prefill_chunk_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.pipeline_depth < 1:
@@ -769,6 +778,11 @@ class ServingConfig:
             raise ValueError(
                 "prefix_cache_min_blocks must be >= 1, got "
                 f"{self.prefix_cache_min_blocks}"
+            )
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError(
+                "prefill_chunk_tokens must be >= 0, got "
+                f"{self.prefill_chunk_tokens}"
             )
 
 

@@ -169,6 +169,17 @@ class EngineLoop:
             engine.preempt_tokens_counter = registry.counter(
                 "preempted_tokens_recomputed_total",
                 "prompt tokens re-prefilled on preemption resume")
+            engine.chunk_counter = registry.counter(
+                "prefill_chunks_total", "prefill chunks dispatched")
+            engine.chunk_tokens_counter = registry.counter(
+                "prefill_chunk_tokens_total",
+                "prompt tokens prefilled via the chunk lane")
+            engine.chunk_interleaved_counter = registry.counter(
+                "chunk_windows_interleaved_total",
+                "scheduler ticks that dispatched chunks alongside a decode window")
+            engine.chunk_dedicated_counter = registry.counter(
+                "chunk_windows_dedicated_total",
+                "scheduler ticks that dispatched chunks with no decode rows live")
             self._c_shed = {
                 kind: registry.counter(
                     "deadline_shed_total",

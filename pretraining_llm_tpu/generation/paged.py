@@ -432,9 +432,12 @@ def _suffix_prefill_sample(
 
     n_rows = suffix.shape[0]
     with activation_mesh(mesh):
+        # q_lens rides along for the kernel attention path only (ragged
+        # per-row DMA elision on TPU); the gather path ignores it, so CPU
+        # outputs are bit-identical with or without it.
         logits, pools = transformer.forward(
             params, suffix, cfg, kv_cache=pools,
-            paged=PagedInfo(block_tables, cached_lens),
+            paged=PagedInfo(block_tables, cached_lens, q_lens=suffix_lens),
         )
         idx = jnp.clip(suffix_lens - 1, 0, t_bucket - 1).astype(jnp.int32)
         last = jnp.take_along_axis(
@@ -463,6 +466,7 @@ def prefill_suffix_into_pool_batched(
     top_p: Optional[float] = None,
     min_p: Optional[float] = None,
     mesh: Any = None,
+    t_bucket: Optional[int] = None,
 ) -> Tuple[jax.Array, transformer.KVCache]:
     """Prefill ONLY the uncached suffixes of N prefix-cache-hit prompts in
     one device program; returns (first sampled token per row — a DEVICE
@@ -473,6 +477,12 @@ def prefill_suffix_into_pool_batched(
     ``cached_lens[i]`` its block-aligned resident prefix length. Rows and
     suffix lengths bucket to powers of two, mirroring
     ``prefill_into_pool_batched``'s jit-cache discipline.
+
+    ``t_bucket`` pins the token-axis shape instead (chunked prefill: the
+    engine feeds fixed-size chunks, so EVERY group — full chunks and the
+    final tail alike — compiles ONE program per row bucket, where pow2
+    length bucketing would recompile per novel prompt-length residue;
+    see ServingEngine._dispatch_prefill_chunks).
     """
     import numpy as np
 
@@ -493,7 +503,12 @@ def prefill_suffix_into_pool_batched(
         )
     max_t = max(len(s) for s in suffixes)
     bucket_rows = 1 << (n - 1).bit_length()
-    t_bucket = 1 << (max_t - 1).bit_length()
+    if t_bucket is None:
+        t_bucket = 1 << (max_t - 1).bit_length()
+    elif t_bucket < max_t:
+        raise ValueError(
+            f"t_bucket={t_bucket} cannot hold a {max_t}-token suffix"
+        )
     suf_arr = np.zeros((bucket_rows, t_bucket), np.int32)
     lens = np.ones((bucket_rows,), np.int32)
     tab_arr = np.zeros((bucket_rows, tables_np.shape[1]), np.int32)

@@ -76,6 +76,13 @@ class _Request:
     # (batch_array, index) until the window it joined is reaped — the
     # engine never syncs just to learn it (see _resolve_first).
     pending_first: Optional[tuple] = None
+    # Chunked prefill: the next prompt index to prefill. None = decode
+    # phase (the whole prompt is resident — monolithic admission, or the
+    # final chunk landed). While set, the row joins NO decode window/spec
+    # round: its committed frontier is mid-prompt, and lockstep garbage
+    # writes for it land at/above that frontier, overwritten by the next
+    # chunk before any mask exposes them (slot-reuse discipline).
+    prefill_pos: Optional[int] = None
 
     @property
     def n_generated(self) -> int:
@@ -136,6 +143,7 @@ class ServingEngine:
         steps_per_sched: int = 1,
         pipeline_depth: int = 2,
         admit_batch: int = 0,
+        prefill_chunk_tokens: int = 0,
         prefix_cache: bool = False,
         prefix_cache_min_blocks: int = 1,
         mesh: Any = None,
@@ -226,6 +234,21 @@ class ServingEngine:
         if admit_batch < 0:
             raise ValueError(f"admit_batch must be >= 0, got {admit_batch}")
         self.admit_batch = int(admit_batch)
+        # Chunked prefill: split each prompt into chunks of at most this
+        # many tokens and interleave them between decode windows instead
+        # of one monolithic prefill at admission — the token budget per
+        # scheduler tick that protects decode TPOT while long prompts
+        # stream in (0 = off, the historical monolithic behavior). The
+        # budget is shared FCFS across all mid-prefill rows each tick;
+        # rows past it wait (a `defer_prefill_chunk` decision). Greedy
+        # outputs are bit-identical either way: chunks ride the SAME
+        # multi-token paged forward as prefix-cache suffix prefill, and a
+        # token's logits depend only on its own prompt prefix.
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got {prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
 
         # Sharded serving: params arrive pre-sharded
         # (generate.shard_params_for_inference); the KV pools shard their
@@ -323,6 +346,14 @@ class ServingEngine:
         self.decisions: Optional[Any] = None
         self.preempt_counter: Optional[Any] = None
         self.preempt_tokens_counter: Optional[Any] = None
+        # Chunked-prefill typed counters (bound by the frontend like the
+        # preemption counters above): chunks dispatched, chunk tokens
+        # prefilled, and ticks whose chunk program rode alongside a
+        # decode window (interleaved) vs alone (dedicated).
+        self.chunk_counter: Optional[Any] = None
+        self.chunk_tokens_counter: Optional[Any] = None
+        self.chunk_interleaved_counter: Optional[Any] = None
+        self.chunk_dedicated_counter: Optional[Any] = None
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
@@ -343,6 +374,13 @@ class ServingEngine:
             # hits) — with prefix_cache_hit_tokens this yields the
             # prefill-reduction ratio bench.py's serving record reports.
             "prefill_tokens": 0,
+            # Chunked-prefill telemetry: chunk programs dispatched, chunk
+            # tokens prefilled through them, and scheduler ticks whose
+            # chunk dispatch shared the tick with a decode window
+            # (interleaved) vs ran alone (dedicated) — the TPOT-protection
+            # signal (interleaved ≫ dedicated under decode load).
+            "prefill_chunks": 0, "prefill_chunk_tokens": 0,
+            "chunk_windows_interleaved": 0, "chunk_windows_dedicated": 0,
         }
         # Cross-request prefix cache: content-addressed page reuse over
         # the allocator (generation/prefix_cache.py). Off by default —
@@ -530,22 +568,49 @@ class ServingEngine:
             b <<= 1
         return min(b, n)
 
+    def _n_decode_rows(self) -> int:
+        """Rows eligible for decode windows/spec rounds: active AND past
+        their prefill phase. Mid-chunk rows are excluded from dispatch
+        snapshots — their frontier is mid-prompt."""
+        return sum(
+            1 for r in self.rows
+            if r is not None and r.prefill_pos is None
+        )
+
+    def _note_chunk_window(self, decoded: bool) -> None:
+        """Tick-level interleave accounting: a chunk program that shared
+        its tick with a decode dispatch protected TPOT (interleaved);
+        one that ran alone had the engine to itself (dedicated)."""
+        if decoded:
+            self.stats["chunk_windows_interleaved"] += 1
+            if self.chunk_interleaved_counter is not None:
+                self.chunk_interleaved_counter.inc()
+        else:
+            self.stats["chunk_windows_dedicated"] += 1
+            if self.chunk_dedicated_counter is not None:
+                self.chunk_dedicated_counter.inc()
+
     def step(self) -> None:
-        """One scheduling round: admit -> grow/preempt -> a window of
-        ``steps_per_sched`` lockstep decode steps (clamped to the active
-        rows' remaining-token budget, or ONE speculative round when
-        spec_k is set) -> reap. A no-op when nothing is running or
-        waiting."""
+        """One scheduling round: admit -> prefill chunks (chunked mode)
+        -> grow/preempt -> a window of ``steps_per_sched`` lockstep
+        decode steps (clamped to the active rows' remaining-token
+        budget, or ONE speculative round when spec_k is set) -> reap.
+        A no-op when nothing is running or waiting."""
         self._admit()
-        if self.n_active == 0:
-            return
+        chunked = self._dispatch_prefill_chunks(defer=False)
+        decoded = self._step_decode() if self._n_decode_rows() else False
+        if chunked:
+            self._note_chunk_window(decoded)
+
+    def _step_decode(self) -> bool:
+        """The synchronous decode arm of step(); True when a decode
+        window (or spec round) actually ran."""
         if self.spec_k:
-            self._spec_step()
-            return
+            return self._spec_step()
         n = self._window_len()
         self._ensure_write_pages(horizon=n)
-        if self.n_active == 0:  # everyone got preempted (tiny pool)
-            return
+        if self._n_decode_rows() == 0:  # everyone got preempted (tiny pool)
+            return False
         # Backstop for the PagedInfo capacity invariant (submit() bounds
         # every request structurally; this keeps scheduler bugs loud).
         # Multi-step windows may overshoot capacity mid-window — that is
@@ -571,11 +636,12 @@ class ServingEngine:
             window = np.asarray(toks)  # (B, n)
         self.stats["steps"] += n
         for row, req in enumerate(self.rows):
-            if req is None:
+            if req is None or req.prefill_pos is not None:
                 continue
             self._consume_tokens(req, row, window[row], advance_seq=True)
+        return True
 
-    def _spec_step(self) -> None:
+    def _spec_step(self) -> bool:
         """One speculative round for every active row: k draft proposals,
         one multi-token target verify, per-row ragged acceptance (1 to
         k+1 tokens emitted per row). The round writes slots
@@ -584,8 +650,8 @@ class ServingEngine:
         overwritten by the next round (slot-reuse discipline)."""
         k = self.spec_k
         self._ensure_write_pages(horizon=k + 1)
-        if self.n_active == 0:  # everyone got preempted (tiny pool)
-            return
+        if self._n_decode_rows() == 0:  # everyone preempted (tiny pool)
+            return False
         paged.check_paged_bounds(self.tables, self.seq_lens, self.block_size)
         self._key, sub = jax.random.split(self._key)
         emit, n_emit, self.pools, self.d_pools = paged.paged_spec_round(
@@ -600,10 +666,10 @@ class ServingEngine:
         self.stats["steps"] += 1
         self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) + 1
         self.stats["spec_proposed"] = (
-            self.stats.get("spec_proposed", 0) + k * self.n_active
+            self.stats.get("spec_proposed", 0) + k * self._n_decode_rows()
         )
         for row, req in enumerate(self.rows):
-            if req is None:
+            if req is None or req.prefill_pos is not None:
                 continue
             self.stats["spec_accepted"] = (
                 self.stats.get("spec_accepted", 0) + int(n_emit[row]) - 1
@@ -611,6 +677,7 @@ class ServingEngine:
             self._consume_tokens(
                 req, row, emit[row, : int(n_emit[row])], advance_seq=True
             )
+        return True
 
     def run(self, *, pipeline: bool = True) -> Dict[int, List[int]]:
         """Drive the engine until every submitted request has finished.
@@ -663,7 +730,13 @@ class ServingEngine:
         engine is fully idle)."""
         depth = self.pipeline_depth
         self._admit(defer=True)
-        if self.n_active:
+        # Chunked prefill rides BEFORE the decode dispatch: its writes
+        # are committed prompt data (earlier in device program order than
+        # this tick's window), and the token budget bounds the prefill
+        # work a decode window ever waits behind — the TPOT protection.
+        chunked = self._dispatch_prefill_chunks(defer=True)
+        decoded = False
+        if self._n_decode_rows():
             if self.spec_k:
                 # Worst case every queued round and the new one
                 # advance the device frontier by k+1 past the
@@ -673,8 +746,9 @@ class ServingEngine:
                 self._ensure_write_pages(
                     horizon=(k + 1) * (len(self._inflight) + 1)
                 )
-                if self.n_active:
+                if self._n_decode_rows():
                     self._dispatch_spec_round()
+                    decoded = True
             else:
                 n = self._window_len()
                 # ONE window length for both the page horizon and the
@@ -691,8 +765,11 @@ class ServingEngine:
                 self._ensure_write_pages(
                     horizon=n, prealloc=n * (depth - 1)
                 )
-                if self.n_active:
+                if self._n_decode_rows():
                     self._dispatch_window(n)
+                    decoded = True
+        if chunked:
+            self._note_chunk_window(decoded)
         # Reap the oldest window once the queue exceeds its depth —
         # by then it has had `depth` windows of device time to finish,
         # so the readback rarely blocks — and drain outright when
@@ -718,7 +795,14 @@ class ServingEngine:
         # block). capacity-1 keeps its garbage writes inside its OWN last
         # block until it is reaped.
         seq_dispatch = np.minimum(self.seq_lens, capacity - 1)
-        active = [i for i, r in enumerate(self.rows) if r is not None]
+        # Mid-prefill rows are NOT in the window: their lockstep writes
+        # are garbage landing at/above their committed frontier (the next
+        # chunk overwrites them before any mask exposes them), their seq
+        # must not advance, and their tokens are never consumed.
+        active = [
+            i for i, r in enumerate(self.rows)
+            if r is not None and r.prefill_pos is None
+        ]
         paged.check_paged_bounds(
             self.tables[active], seq_dispatch[active], self.block_size
         )
@@ -755,7 +839,14 @@ class ServingEngine:
         k = self.spec_k
         capacity = self.max_blocks * self.block_size
         seq_committed = np.minimum(self.seq_lens, capacity - 1)
-        active = [i for i, r in enumerate(self.rows) if r is not None]
+        # Same exclusion as _dispatch_window: mid-prefill rows ride no
+        # spec round (their chained seq_dev is reset to the committed
+        # frontier by the chunk dispatch's merge entry, so their garbage
+        # writes stay at/above it).
+        active = [
+            i for i, r in enumerate(self.rows)
+            if r is not None and r.prefill_pos is None
+        ]
         # The bounds invariant is checked on COMMITTED state (a lower
         # bound on the device frontier); in-flight advances stay inside
         # the pre-ensured horizon by construction.
@@ -1097,17 +1188,21 @@ class ServingEngine:
             req.admit_order = self._admit_counter
             self._admit_counter += 1
             self.stats["admissions"] += 1
-            self.stats["prefill_tokens"] += p - cached_len
-            if req.preemptions > 0:
-                # Recompute-on-resume rework, counted where it is actually
-                # PAID: the re-admission's prefill (a cache hit on the
-                # victim's own published pages shrinks it).
-                self.stats["preempted_tokens_recomputed"] = (
-                    self.stats.get("preempted_tokens_recomputed", 0)
-                    + p - cached_len
-                )
-                if self.preempt_tokens_counter is not None:
-                    self.preempt_tokens_counter.inc(p - cached_len)
+            if not self.prefill_chunk_tokens:
+                # Chunked mode counts prefill (and recompute rework) at
+                # chunk DISPATCH — where the tokens are actually paid —
+                # so a mid-prefill cancellation never inflates either.
+                self.stats["prefill_tokens"] += p - cached_len
+                if req.preemptions > 0:
+                    # Recompute-on-resume rework, counted where it is
+                    # actually PAID: the re-admission's prefill (a cache
+                    # hit on the victim's own published pages shrinks it).
+                    self.stats["preempted_tokens_recomputed"] = (
+                        self.stats.get("preempted_tokens_recomputed", 0)
+                        + p - cached_len
+                    )
+                    if self.preempt_tokens_counter is not None:
+                        self.preempt_tokens_counter.inc(p - cached_len)
             t = self.req_timing.get(req.rid)
             if t is not None:
                 # setdefault: a preempted request's re-admission must not
@@ -1141,10 +1236,22 @@ class ServingEngine:
             self.rows[row] = req  # claim now: n_active sees earlier admits
             self.tables[row, :] = 0
             self.tables[row, : len(req.blocks)] = req.blocks
-            self.seq_lens[row] = p
+            if self.prefill_chunk_tokens:
+                # Chunked admission: claim the row and ALL its blocks
+                # (same watermark math — the allocation is identical),
+                # but run NO prefill here. The committed frontier starts
+                # at the cached prefix; _dispatch_prefill_chunks streams
+                # the rest in budgeted chunks, cache hits riding the
+                # same lane with a head start.
+                req.prefill_pos = cached_len
+                self.seq_lens[row] = cached_len
+            else:
+                self.seq_lens[row] = p
             admits.append(req)
         if not admits:
             return
+        if self.prefill_chunk_tokens:
+            return  # prompts stream in via _dispatch_prefill_chunks
         # Cache hits prefill ONLY their uncached suffix (shared pages are
         # already in the table; PagedInfo seq = cached length), misses run
         # the full prefill — one batched program per non-empty group.
@@ -1228,6 +1335,154 @@ class ServingEngine:
                 self.tokens[req.row] = tok
                 if tok == self.stop_token or len(req.generated) >= req.max_new:
                     self._finish(req)
+
+    def _dispatch_prefill_chunks(self, defer: bool) -> bool:
+        """Stream mid-prefill rows' next prompt chunks in ONE multi-token
+        paged forward (the prefix-cache suffix lane with a PINNED token
+        bucket), token-budgeted to ``prefill_chunk_tokens`` per tick so
+        the decode window dispatched right after never waits behind more
+        than one budget of prefill compute. FCFS by admission order;
+        rows past the budget wait (a ``defer_prefill_chunk`` decision).
+        A row's FINAL chunk samples its first output token from the last
+        prompt position — exactly the monolithic prefill's sample — and
+        the row joins the very next decode window. Returns True when a
+        chunk program was dispatched (the interleave accounting hook).
+
+        Commit discipline: a chunk is committed AT DISPATCH — its
+        content is deterministic prompt data, not speculation — so
+        ``seq_lens``/``prefill_pos`` advance immediately and a
+        reconciliation flush never needs to rewind chunk state. In spec
+        mode every chunked row also queues a merge entry: the next
+        round's chained ``seq_dev`` must be reset to the committed
+        frontier so the excluded row's lockstep garbage lands at/above
+        it, never below."""
+        if not self.prefill_chunk_tokens:
+            return False
+        pending = sorted(
+            (r for r in self.rows
+             if r is not None and r.prefill_pos is not None),
+            key=lambda r: r.admit_order,
+        )
+        if not pending:
+            return False
+        budget = self.prefill_chunk_tokens
+        group: List[_Request] = []
+        chunks: List[List[int]] = []
+        offsets: List[int] = []
+        finals: List[bool] = []
+        for req in pending:
+            if budget <= 0:
+                self.stats["chunk_deferrals"] = (
+                    self.stats.get("chunk_deferrals", 0) + 1
+                )
+                if self.decisions is not None:
+                    self.decisions.record(
+                        "defer_prefill_chunk",
+                        rid=req.rid,
+                        trace_id=getattr(
+                            self.traces.get(req.rid), "trace_id", None
+                        ),
+                        budget=self.prefill_chunk_tokens,
+                        tokens_left=len(req.prompt) - req.prefill_pos,
+                    )
+                continue
+            start = req.prefill_pos
+            take = min(budget, len(req.prompt) - start)
+            group.append(req)
+            chunks.append(req.prompt[start:start + take])
+            offsets.append(start)
+            finals.append(start + take == len(req.prompt))
+            budget -= take
+        t_chunk = time.perf_counter()
+        with _spans.span(
+            "serving.dispatch_chunks",
+            rows=len(group), tokens=sum(len(c) for c in chunks),
+        ):
+            self._key, sub = jax.random.split(self._key)
+            tables_rows = self.tables[np.asarray([r.row for r in group])]
+            toks_dev, self.pools = paged.prefill_suffix_into_pool_batched(
+                self.params, self.cfg, self.pools, chunks, tables_rows,
+                offsets, sub, temperature=self.temperature,
+                top_k=self.top_k, top_p=self.top_p, min_p=self.min_p,
+                mesh=self.mesh, t_bucket=self.prefill_chunk_tokens,
+            )
+            if self.spec_k:
+                # The draft pool must hold the same chunk K/V (shared
+                # block ids index both pools); its sampled tokens are
+                # discarded — the target's final-chunk token seeds the
+                # round either way.
+                _, self.d_pools = paged.prefill_suffix_into_pool_batched(
+                    self.draft_params, self.draft_cfg, self.d_pools,
+                    chunks, tables_rows, offsets, sub,
+                    temperature=self.temperature, mesh=self.mesh,
+                    t_bucket=self.prefill_chunk_tokens,
+                )
+        t_chunk_end = time.perf_counter()
+        final_idxs: List[int] = []
+        for i, req in enumerate(group):
+            take = len(chunks[i])
+            req.prefill_pos = None if finals[i] else offsets[i] + take
+            self.seq_lens[req.row] = offsets[i] + take
+            self.stats["prefill_chunks"] += 1
+            self.stats["prefill_chunk_tokens"] += take
+            self.stats["prefill_tokens"] += take
+            if req.preemptions > 0:
+                # Every chunk of a preemption resume is recompute rework
+                # (its prompt IS the prior incarnation's prompt+output).
+                self.stats["preempted_tokens_recomputed"] = (
+                    self.stats.get("preempted_tokens_recomputed", 0) + take
+                )
+                if self.preempt_tokens_counter is not None:
+                    self.preempt_tokens_counter.inc(take)
+            if self.chunk_counter is not None:
+                self.chunk_counter.inc()
+            if self.chunk_tokens_counter is not None:
+                self.chunk_tokens_counter.inc(take)
+            if self.traces:
+                tr = self.traces.get(req.rid)
+                if tr is not None:
+                    # One span per (request, chunk); batched groups share
+                    # the host interval, like req.prefill. The request's
+                    # decode windows all start after its final chunk, so
+                    # these never overlap its req.window spans — the
+                    # waterfall's sum-to-e2e invariant survives.
+                    tr.span(
+                        "req.prefill_chunk", t_chunk, t_chunk_end,
+                        offset=offsets[i], chunk_tokens=take,
+                        final=finals[i], batch=len(group),
+                    )
+            if finals[i]:
+                final_idxs.append(i)
+        if final_idxs:
+            self.stats["tokens"] += len(final_idxs)  # prefill-sampled firsts
+        if defer:
+            for i in final_idxs:
+                group[i].pending_first = (toks_dev, i)
+            if self.spec_k:
+                # ALL chunked rows merge: finals contribute their real
+                # first token; non-finals just pin seq_dev back to the
+                # committed frontier (their base token is garbage and
+                # never consumed — the row is outside every snapshot).
+                self._pending_admit_merges.append(
+                    (toks_dev, list(range(len(group))),
+                     [r.row for r in group])
+                )
+            elif final_idxs:
+                self._pending_admit_merges.append(
+                    (toks_dev, final_idxs,
+                     [group[i].row for i in final_idxs])
+                )
+        else:
+            toks = np.asarray(toks_dev)
+            for i in final_idxs:
+                req = group[i]
+                tok = int(toks[i])
+                req.generated.append(tok)
+                self._emit_token(req, tok)
+                self.tokens[req.row] = tok
+                if tok == self.stop_token or len(req.generated) >= req.max_new:
+                    self._finish(req)
+        return True
 
     def _ensure_write_pages(self, horizon: int = 1, prealloc: int = 0) -> None:
         """Every active row's next ``horizon`` write slots must have
@@ -1435,7 +1690,15 @@ class ServingEngine:
             # safe even mid-pipeline.
             g = len(req.generated)
             p = len(req.prompt)
-            publish_len = p + g - 1 if g else p
+            if req.prefill_pos is not None:
+                # Mid-prefill release (chunked cancellation/preemption):
+                # only chunks below prefill_pos ever landed — publish
+                # exactly those. A resume then re-acquires its OWN
+                # partial prefix from the cache, so the rework shrinks
+                # to the unprefilled remainder.
+                publish_len = req.prefill_pos
+            else:
+                publish_len = p + g - 1 if g else p
             self.prefix_cache.release_row(
                 req.prompt + req.generated, req.blocks, req.n_shared,
                 publish_len,

@@ -65,6 +65,14 @@ class PagedInfo(NamedTuple):
 
     block_tables: jax.Array  # (B, max_blocks) int32 — pool block ids per row
     seq_lens: jax.Array  # (B,) int32 — tokens already in the cache per row
+    # Ragged multi-token calls (chunked prefill): row b's TRUE query count
+    # (<= T); queries past it are padding whose outputs the caller
+    # discards. None = uniform (every row carries all T queries — decode
+    # steps and the speculative verify). Only the kernel attention path
+    # reads it (per-row DMA elision + pad-query masking); the gather path
+    # computes pad queries and lets the caller discard them, so outputs
+    # for REAL queries are bit-identical whether or not q_lens is passed.
+    q_lens: Optional[jax.Array] = None  # (B,) int32 or None
 
 
 def _lm_head_weights(params: Params, cfg: ModelConfig):
@@ -339,19 +347,36 @@ def _attention_block(
             # speculative verify's per-query frontiers live inside the
             # kernel mask). (int8 pools keep the gather below: validation
             # rejects the combination at config time.)
-            from pretraining_llm_tpu.ops.pallas_paged import (
-                paged_decode_attention,
-            )
+            if tq > 1 and paged.q_lens is not None:
+                # Ragged multi-token form (chunked prefill): rows carry
+                # heterogeneous true query counts; the ragged kernel
+                # elides DMA past each row's OWN chunk end instead of
+                # scanning every row to the longest row's frontier.
+                from pretraining_llm_tpu.ops.pallas_ragged import (
+                    ragged_paged_attention,
+                )
 
-            qin = q[:, 0] if tq == 1 else q
-            out = paged_decode_attention(
-                qin.astype(cdt),
-                new_kv["k_pool"].astype(cdt),
-                new_kv["v_pool"].astype(cdt),
-                tables, seq, window=cfg.sliding_window,
-            )
-            if tq == 1:
-                out = out[:, None]
+                out = ragged_paged_attention(
+                    q.astype(cdt),
+                    new_kv["k_pool"].astype(cdt),
+                    new_kv["v_pool"].astype(cdt),
+                    tables, seq, paged.q_lens,
+                    window=cfg.sliding_window,
+                )
+            else:
+                from pretraining_llm_tpu.ops.pallas_paged import (
+                    paged_decode_attention,
+                )
+
+                qin = q[:, 0] if tq == 1 else q
+                out = paged_decode_attention(
+                    qin.astype(cdt),
+                    new_kv["k_pool"].astype(cdt),
+                    new_kv["v_pool"].astype(cdt),
+                    tables, seq, window=cfg.sliding_window,
+                )
+                if tq == 1:
+                    out = out[:, None]
         else:
             max_blocks = tables.shape[1]
             kv_len = max_blocks * block_size

@@ -52,6 +52,8 @@ DECISION_KINDS = (
     "evict_cold",         # cold prefix-cache blocks reclaimed for a live row
     "reclaim_spec",       # speculative page grants rolled back under pressure
     "expire_inflight",    # deadline passed mid-decode -> cancelled (504)
+    "defer_prefill_chunk",  # chunk budget spent this tick; prompt waits a window
+
     # Fleet-tier decisions (frontend/router.py): each costs a request a
     # retry, a re-prefill, or its slot, so they live in the same ledger.
     "eject_replica",      # router declared a replica dead/wedged and stopped routing to it

@@ -1,0 +1,288 @@
+"""Ragged paged-attention Pallas kernel: heterogeneous query counts per row.
+
+`ops/pallas_paged.py` serves a batch where every row carries the SAME
+number of query tokens (1 at decode, k+1 at the speculative verify).
+Chunked prefill breaks that symmetry: one launch now mixes decode rows
+(q_len 1..spec_depth) with prefill-chunk rows (q_len up to
+`serving.prefill_chunk_tokens`), each row's queries starting at its own
+committed offset `seq_lens[b]`. This kernel is the uniform kernel
+generalized by ONE extra scalar-prefetch operand, `q_lens (B,)`:
+
+  - Block liveness becomes per-row: page j is fetched/computed only when
+    ``j*bs <= seq + (q_len - 1)`` — a decode row (q_len 1) stops at its
+    frontier page while a chunk row in the same launch scans up to its
+    chunk end. Dead table entries stay 0 (the reserved scratch block), so
+    consecutive identical indices elide their DMA exactly as in
+    pallas_paged.py.
+  - The causal mask gains a query-validity term: query t of row b is
+    real only when ``t < q_lens[b]``; pad queries (the static T bound
+    minus the row's true count) are fully masked and finalize to zeros
+    via the safe-l division — they cost VPU lanes, never HBM traffic
+    beyond the row's live pages.
+  - Online-softmax f32 accumulators in VMEM and the GQA-native shared
+    K/V blocks are inherited unchanged (heads-major fold keeps each
+    group's rows contiguous for the static group slices).
+
+`ragged_gather_attention` below is the XLA fallback: the same
+pool-gather + per-query masked softmax the model's gather branch runs,
+extended with the q_len validity mask. CPU tier-1 tests pin the kernel
+against it (interpret mode), and chunked-vs-monolithic bit-identity on
+CPU rides the model's gather branch, which ignores q_lens entirely —
+pad-query outputs are computed and discarded there, so real-query
+numerics are untouched by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite: exp/max edge cases (same constant as pallas_paged)
+
+
+def _ragged_kernel(
+    tbl_ref,  # (B, nb) int32 scalar-prefetch (SMEM)
+    seq_ref,  # (B,) int32 scalar-prefetch (SMEM)
+    qlen_ref,  # (B,) int32 scalar-prefetch (SMEM) — true queries per row
+    q_ref,  # (1, H*T, Dh) — heads-major fold, query t at row h*T + t
+    k_ref,  # (1, bs, G, Dh) — the page tbl[b, j]
+    v_ref,  # (1, bs, G, Dh)
+    o_ref,  # (1, H*T, Dh)
+    acc,  # VMEM (H*T, Dh) f32
+    m_scr,  # VMEM (H*T, 1) f32
+    l_scr,  # VMEM (H*T, 1) f32
+    *,
+    bs: int,
+    nb: int,
+    g: int,
+    n_rep: int,
+    t: int,
+    scale: float,
+    window: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    seq = seq_ref[b]
+    qlen = qlen_ref[b]
+    # Per-row block liveness: the LAST real query of this row sits at
+    # slot seq + qlen - 1; pages past it are dead for this row even when
+    # another row in the launch reaches further (the uniform kernel's
+    # static (t-1) bound made every row pay the longest row's scan).
+    # qlen == 0 rows (pure padding) run no block at all.
+    run = j * bs <= seq + (qlen - 1)
+    if window:
+        run = jnp.logical_and(run, j * bs + bs - 1 > seq - window)
+
+    @pl.when(run)
+    def _compute():
+        rows = n_rep * t
+        # Row r within a group is query (r % t) of head (r // t); the
+        # heads-major fold keeps each GQA group's rows contiguous so the
+        # static slice below works.
+        t_of_row = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) % t
+        lin = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        # Causal frontier per query PLUS query validity: queries at or
+        # past the row's true count are padding (fully masked; finalize
+        # zeros them via safe_l).
+        valid = jnp.logical_and(lin <= seq + t_of_row, t_of_row < qlen)
+        if window:
+            valid = jnp.logical_and(valid, lin > seq + t_of_row - window)
+        q = q_ref[0]  # (H*T, Dh)
+        k = k_ref[0]  # (bs, G, Dh)
+        v = v_ref[0]
+        for grp in range(g):
+            sl = slice(grp * rows, (grp + 1) * rows)
+            qg = q[sl]  # (n_rep*T, Dh)
+            kg = k[:, grp]  # (bs, Dh)
+            vg = v[:, grp]
+            s = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (n_rep*T, bs)
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_scr[sl]  # (n_rep*T, 1)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            # Fully-masked rows keep m == NEG_INF -> exp(s-m)=1 on masked
+            # entries; zeroed by the mask itself (flash kernel discipline).
+            p = jnp.where(valid, p, 0.0)
+            l_scr[sl] = l_scr[sl] * alpha + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            m_scr[sl] = m_new
+            pv = jax.lax.dot_general(
+                p.astype(vg.dtype), vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc[sl] = acc[sl] * alpha + pv
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("t", "window", "interpret"))
+def _ragged_call(q, k_pool, v_pool, block_tables, seq_lens, q_lens, t,
+                 window, interpret):
+    b, ht, d = q.shape  # ht == H * T, heads-major fold
+    n_blocks, bs, g, _ = k_pool.shape
+    nb = block_tables.shape[1]
+    n_rep = ht // (g * t)
+    kernel = functools.partial(
+        _ragged_kernel, bs=bs, nb=nb, g=g, n_rep=n_rep, t=t,
+        scale=1.0 / (d**0.5), window=window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, ht, d), lambda bb, j, tbl, seq, ql: (bb, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bs, g, d),
+                lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, g, d),
+                lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, ht, d), lambda bb, j, tbl, seq, ql: (bb, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((ht, d), jnp.float32),
+            pltpu.VMEM((ht, 1), jnp.float32),
+            pltpu.VMEM((ht, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, ht, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_lens.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # (B, T, H, Dh) — T is the batch's MAX query count
+    k_pool: jax.Array,  # (n_blocks, block_size, G, Dh)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32, 0-padded tails
+    seq_lens: jax.Array,  # (B,) int32 — row b's committed offset
+    q_lens: jax.Array,  # (B,) int32 — row b's TRUE query count, <= T
+    *,
+    window: int = 0,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ragged paged attention straight off the block pool.
+
+    One launch serves rows with heterogeneous query counts: row b's
+    query t sits at logical slot ``seq_lens[b] + t`` and sees slots
+    ``<= seq_lens[b] + t`` (its own just-written K/V inclusive —
+    identical to the gather path's per-query frontier), but only
+    queries ``t < q_lens[b]`` are real; the rest are padding whose
+    outputs come back as zeros and must be discarded by the caller.
+    A decode row rides with q_len 1, a prefill chunk with its chunk
+    length — the mixed batch costs each row only ITS OWN live pages
+    (per-row DMA elision), not the longest row's scan.
+
+    Invariant (caller-enforced, unchecked under jit): 0 <= q_lens <= T
+    and seq_lens + q_lens <= max_blocks * block_size. Returns q's
+    shape. `interpret=None` auto-selects: compiled on TPU, interpreter
+    elsewhere (tests).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if q.ndim != 4:
+        raise ValueError(
+            f"ragged attention takes (B, T, H, Dh) queries, got {q.shape} "
+            f"(single-token decode belongs to paged_decode_attention)"
+        )
+    b, t, h, d = q.shape
+    # Heads-major fold (H*T rows, query t of head h at row h*T + t):
+    # keeps each GQA group's rows CONTIGUOUS for the kernel's static
+    # group slices — same fold as the uniform multi-token kernel.
+    qf = q.transpose(0, 2, 1, 3).reshape(b, h * t, d)
+    g = k_pool.shape[2]
+    if h % g != 0:
+        raise ValueError(f"kv heads ({g}) must divide query heads ({h})")
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(f"k/v pool mismatch: {k_pool.shape} vs {v_pool.shape}")
+    if block_tables.shape[0] != b or seq_lens.shape != (b,):
+        raise ValueError(
+            f"tables {block_tables.shape} / seq_lens {seq_lens.shape} do not "
+            f"match batch {b}"
+        )
+    if q_lens.shape != (b,):
+        raise ValueError(f"q_lens {q_lens.shape} does not match batch {b}")
+    out = _ragged_call(
+        qf, k_pool, v_pool, block_tables, seq_lens, q_lens, t, int(window),
+        bool(interpret),
+    )
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def ragged_gather_attention(
+    q: jax.Array,  # (B, T, H, Dh)
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    q_lens: jax.Array,
+    *,
+    window: int = 0,
+) -> jax.Array:
+    """XLA gather fallback: materialize ``pool[tables]`` and run the
+    per-query masked softmax — the model's gather branch math with the
+    ragged validity term added. ONE source of truth for what the kernel
+    must compute; tier-1 CPU tests pin the kernel (interpret mode)
+    against this. Pad queries (t >= q_lens[b]) return zeros, matching
+    the kernel's safe-l finalize."""
+    b, t, h, d = q.shape
+    g = k_pool.shape[2]
+    n_rep = h // g
+    bs = k_pool.shape[1]
+    kv_len = block_tables.shape[1] * bs
+    ck = k_pool[block_tables].reshape(b, kv_len, g, d)
+    cv = v_pool[block_tables].reshape(b, kv_len, g, d)
+    if n_rep > 1:
+        ck = jnp.repeat(ck, n_rep, axis=2)
+        cv = jnp.repeat(cv, n_rep, axis=2)
+    lin = jnp.arange(kv_len)
+    pos = seq_lens[:, None] + jnp.arange(t)[None, :]  # (B, T)
+    mask = lin[None, None, :] <= pos[:, :, None]  # (B, T, kv_len)
+    if window:
+        mask = mask & (lin[None, None, :] > pos[:, :, None] - window)
+    qvalid = jnp.arange(t)[None, :] < q_lens[:, None]  # (B, T)
+    mask = mask & qvalid[:, :, None]
+    s = jnp.einsum(
+        "bthd,bkhd->bthk", q.astype(jnp.float32), ck.astype(jnp.float32)
+    ) / (d**0.5)
+    s = jnp.where(mask[:, :, None, :], s, NEG_INF)
+    # Pad queries are fully masked: a plain softmax would spread 1/kv_len
+    # everywhere; zero them like the kernel's safe-l division does.
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask[:, :, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bthk,bkhd->bthd", p, cv.astype(jnp.float32))
+    return out.astype(q.dtype)

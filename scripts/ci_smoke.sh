@@ -104,6 +104,44 @@ print(f"prefix cache smoke ok: {st['prefix_cache_hits']} hits, "
       f"{st['prefill_tokens']} prefill tokens")
 EOF
 
+# Chunked-prefill gate: the SAME mixed-length workload with chunking off
+# and on (6-token budget, so every longer prompt takes several chunks)
+# must produce bit-identical greedy outputs, actually stream chunks, and
+# leave the allocator fully accounted for at drain.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax, dataclasses
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+rng = np.random.default_rng(7)
+prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist()
+           for n in (21, 4, 17, 9, 26, 12)]
+
+def run(chunk):
+    eng = ServingEngine(params, cfg, max_batch=3, n_blocks=32, block_size=8,
+                        temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                        prefill_chunk_tokens=chunk)
+    rids = [eng.submit(p, 8) for p in prompts]
+    out = eng.run(pipeline=True)
+    return [out[r] for r in rids], eng
+
+off, _ = run(0)
+on, eng = run(6)
+assert off == on, "chunked prefill changed greedy outputs"
+st = eng.stats
+assert st["prefill_chunks"] > len(prompts), st  # long prompts took several
+assert st["prefill_chunk_tokens"] == sum(len(p) for p in prompts), st
+assert eng.alloc.available == 32 - 1, eng.alloc.available
+print(f"chunked prefill smoke ok: {st['prefill_chunks']} chunks, "
+      f"{st['prefill_chunk_tokens']} chunk tokens, "
+      f"interleaved={st['chunk_windows_interleaved']} "
+      f"dedicated={st['chunk_windows_dedicated']}")
+EOF
+
 # Gateway gate: the ONLINE path end-to-end over real HTTP. A tiny random-
 # init model behind EngineLoop + ServingGateway serves 4 concurrent
 # requests — one SSE-streaming, one cancelled mid-generation by dropping

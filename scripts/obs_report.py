@@ -173,7 +173,8 @@ def timeline(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 # decomposition below keys on them.
 _ROOT = "req.request"
 _TERMINAL = "req.terminal"
-_SEGMENT_SPANS = ("req.queue", "req.admission", "req.prefill", "req.window")
+_SEGMENT_SPANS = ("req.queue", "req.admission", "req.prefill",
+                  "req.prefill_chunk", "req.window")
 
 
 def load_trace(path: str) -> Dict[str, Any]:
@@ -254,6 +255,10 @@ def check_trace_tree(trace_id: str, spans: List[Dict[str, Any]]) -> List[str]:
         "rejected": ("req.admission", _TERMINAL),
     }.get(status, ("req.queue", _TERMINAL))
     for name in need:
+        if name == "req.prefill" and "req.prefill_chunk" in by_name:
+            # Chunked prefill replaces the monolithic prefill span with
+            # one span per chunk; either form proves the prompt landed.
+            continue
         if name not in by_name:
             problems.append(
                 f"trace {short} ({status}): missing {name} span"
@@ -281,17 +286,23 @@ def request_waterfall(trace_id: str, spans: List[Dict[str, Any]]) -> Dict[str, A
     queue_s = _union_s(clipped("req.queue"))
     admission_s = _union_s(clipped("req.admission"))
     prefill_s = _union_s(clipped("req.prefill"))
+    # Chunked prefill emits one span per chunk instead of one monolithic
+    # req.prefill; union them into their own segment so the waterfall
+    # shows how much of TTFT the chunk lane itself consumed.
+    chunked_prefill_s = _union_s(clipped("req.prefill_chunk"))
     windows = [ev for ev in spans if ev["name"] == "req.window"]
     decode_union_s = _union_s(clipped("req.window"))
     host_blocked_s = min(
         decode_union_s,
         sum(float(ev["args"].get("host_blocked_s", 0.0)) for ev in windows),
     )
-    claimed = queue_s + admission_s + prefill_s + decode_union_s
+    claimed = (queue_s + admission_s + prefill_s + chunked_prefill_s
+               + decode_union_s)
     segments = {
         "queue_s": queue_s,
         "admission_s": admission_s,
         "prefill_s": prefill_s,
+        "chunked_prefill_s": chunked_prefill_s,
         "decode_s": decode_union_s - host_blocked_s,
         "host_blocked_s": host_blocked_s,
         "other_s": max(0.0, e2e_s - claimed),
@@ -382,8 +393,8 @@ def build_slo_report(
     }
 
 
-_SEG_ORDER = ("queue_s", "admission_s", "prefill_s", "decode_s",
-              "host_blocked_s", "other_s")
+_SEG_ORDER = ("queue_s", "admission_s", "prefill_s", "chunked_prefill_s",
+              "decode_s", "host_blocked_s", "other_s")
 
 
 def print_slo_report(report: Dict[str, Any]) -> None:

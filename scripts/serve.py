@@ -88,6 +88,12 @@ def main() -> None:
     parser.add_argument("--prefix_cache_min_blocks", type=int, default=0,
                         help="shortest cached prefix (in blocks) worth "
                         "mapping (0 = config default)")
+    parser.add_argument("--prefill_chunk_tokens", type=int, default=0,
+                        help="chunked prefill: stream prompts into the pool "
+                        "in chunks of at most this many tokens, interleaved "
+                        "with decode windows, instead of one monolithic "
+                        "prefill per admission (0 = config default, which "
+                        "is off; greedy outputs are identical either way)")
     parser.add_argument("--tokenizer", default=None,
                         help="override the checkpoint's tokenizer name")
     parser.add_argument("--output", default="",
@@ -181,6 +187,9 @@ def main() -> None:
             prefix_cache_min_blocks=(
                 args.prefix_cache_min_blocks
                 or cfg.serving.prefix_cache_min_blocks
+            ),
+            prefill_chunk_tokens=(
+                args.prefill_chunk_tokens or cfg.serving.prefill_chunk_tokens
             ),
             **spec,
         )

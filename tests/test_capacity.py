@@ -108,7 +108,7 @@ def test_capacity_event_kinds_documented():
     assert "decision" in EVENT_KINDS
     assert set(DECISION_KINDS) == {
         "reject_busy", "reject_infeasible", "preempt", "evict_cold",
-        "reclaim_spec", "expire_inflight",
+        "reclaim_spec", "expire_inflight", "defer_prefill_chunk",
         # fleet tier (frontend/router.py)
         "eject_replica", "redrive", "brownout_shed",
     }

@@ -1,0 +1,407 @@
+"""Chunked prefill interleaved into decode windows.
+
+The correctness bar (CPU-enforced, gather path): greedy tokens are
+BIT-IDENTICAL with chunking on vs off at every pipeline depth, with and
+without the prefix cache and speculative decoding — a chunk boundary
+that moved a single token would be a commit-discipline bug, not a perf
+trade-off. On top of identity: decode rows are never starved by chunk
+traffic (a decode window dispatches on EVERY tick that has decode-phase
+rows), the allocator stays conserved through mid-prefill cancellation
+and preemption, and the pinned {chunk, tail} shape discipline serves
+novel prompt lengths with ZERO new compiles once warm.
+"""
+
+import dataclasses
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation.generate import generate
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.device import CompileWatcher
+
+# The offline analyzer doubles as the trace-tree checker: import it as a
+# module so the tests assert with EXACTLY the logic the CI gate runs.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_chunked", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1, d_model=16, n_heads=2)
+
+DEPTHS = [1, 2, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return transformer.init_params(DRAFT_CFG, jax.random.key(99))
+
+
+def _prompts(n, lengths=(5, 19, 14, 7, 23, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    out = []
+    for i in range(n):
+        p = int(lengths[i % len(lengths)])
+        out.append(rng.integers(0, CFG.vocab_size, size=p).tolist())
+    return out
+
+
+def _reference_greedy(params, cfg, prompt, n_new):
+    toks = generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), n_new,
+        jax.random.key(7), temperature=0.0,
+    )
+    return np.asarray(toks)[0].tolist()
+
+
+def _run(params, prompts, n_new, *, chunk, depth, pipeline=True, **kw):
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, pipeline_depth=depth,
+        prefill_chunk_tokens=chunk, **kw,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    out = eng.run(pipeline=pipeline)
+    return [out[r] for r in rids], eng
+
+
+# -- bit-identity: chunked on vs off --------------------------------------
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+@pytest.mark.parametrize("cache", [False, True])
+def test_chunked_identity(params, depth, cache):
+    """Chunked vs monolithic prefill over admission churn (more requests
+    than rows) must agree bit-for-bit, and with the reference greedy.
+    A 6-token budget makes most prompts take several chunks and forces
+    per-tick deferrals (the budget loop), not just the happy path."""
+    prompts = _prompts(6)
+    n_new = 9
+    off, _ = _run(
+        params, prompts, n_new, chunk=0, depth=depth,
+        max_batch=2, n_blocks=32, block_size=8, steps_per_sched=4,
+        prefix_cache=cache,
+    )
+    on, eng = _run(
+        params, prompts, n_new, chunk=6, depth=depth,
+        max_batch=2, n_blocks=32, block_size=8, steps_per_sched=4,
+        prefix_cache=cache,
+    )
+    assert on == off
+    assert eng.stats["prefill_chunks"] > len(prompts)
+    for got, p in zip(on, prompts):
+        assert got == _reference_greedy(params, CFG, p, n_new)
+    assert eng.stats["windows_reaped"] == eng.stats["windows"]
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+@pytest.mark.parametrize("cache", [False, True])
+def test_chunked_identity_speculative(params, draft_params, depth, cache):
+    """Same identity bar through the speculative scheduler: chunk commits
+    must never disturb the draft/target verify state of rows excluded
+    from a spec round mid-prefill."""
+    prompts = _prompts(5)
+    n_new = 8
+    spec = dict(draft_params=draft_params, draft_cfg=DRAFT_CFG, spec_k=2)
+    off, _ = _run(
+        params, prompts, n_new, chunk=0, depth=depth,
+        max_batch=2, n_blocks=32, block_size=8, prefix_cache=cache, **spec,
+    )
+    on, eng = _run(
+        params, prompts, n_new, chunk=5, depth=depth,
+        max_batch=2, n_blocks=32, block_size=8, prefix_cache=cache, **spec,
+    )
+    assert on == off
+    assert eng.stats["prefill_chunks"] > len(prompts)
+    for got, p in zip(on, prompts):
+        assert got == _reference_greedy(params, CFG, p, n_new)
+
+
+def test_chunked_identity_sync_scheduler(params):
+    """The synchronous scheduler (run(pipeline=False)) rides the same
+    chunk lane with host-resolved first tokens — identical too."""
+    prompts = _prompts(4)
+    off, _ = _run(
+        params, prompts, 7, chunk=0, depth=1, pipeline=False,
+        max_batch=2, n_blocks=32, block_size=8,
+    )
+    on, eng = _run(
+        params, prompts, 7, chunk=4, depth=1, pipeline=False,
+        max_batch=2, n_blocks=32, block_size=8,
+    )
+    assert on == off
+    assert eng.stats["prefill_chunks"] > 0
+
+
+def test_chunk_stats_and_stop_token(params):
+    """Token accounting: every prompt token goes through the chunk lane
+    exactly once (no cache, no preemption), and stop tokens landing after
+    a chunked prefill still truncate identically."""
+    prompts = _prompts(3)
+    n_new = 12
+    refs = [_reference_greedy(params, CFG, p, n_new) for p in prompts]
+    stop = refs[0][4]
+    off, _ = _run(
+        params, prompts, n_new, chunk=0, depth=2,
+        max_batch=3, n_blocks=32, block_size=8, stop_token=stop,
+    )
+    on, eng = _run(
+        params, prompts, n_new, chunk=6, depth=2,
+        max_batch=3, n_blocks=32, block_size=8, stop_token=stop,
+    )
+    assert on == off
+    assert eng.stats["prefill_chunk_tokens"] == sum(len(p) for p in prompts)
+    assert eng.stats["prefill_tokens"] == sum(len(p) for p in prompts)
+
+
+# -- decode windows are never starved by chunk traffic ---------------------
+
+
+def test_decode_never_skipped_while_chunks_stream(params):
+    """Structural starvation guard: on EVERY pipeline tick where decode-
+    phase rows exist, a decode window is dispatched — chunk programs ride
+    ALONGSIDE decode windows, never instead of them (so a decode row can
+    never be skipped even once, let alone two consecutive windows). A
+    2-token budget against 19+ token prompts maximizes chunk pressure."""
+    prompts = _prompts(4, lengths=(19, 23, 16, 14))
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, pipeline_depth=2,
+        prefill_chunk_tokens=2, max_batch=2, n_blocks=48, block_size=8,
+        steps_per_sched=2,
+    )
+    decode_dispatches = []
+    orig_window = eng._dispatch_window
+
+    def spy_window(*a, **kw):
+        decode_dispatches.append(True)
+        return orig_window(*a, **kw)
+
+    eng._dispatch_window = spy_window
+    rids = [eng.submit(p, 8) for p in prompts]
+    skipped = []
+    while eng.has_work() or eng._inflight:
+        had_decode = eng._n_decode_rows() > 0
+        before = len(decode_dispatches)
+        eng.pipeline_tick()
+        if had_decode and len(decode_dispatches) == before:
+            skipped.append(eng.stats["windows"])
+    assert not skipped, f"decode window skipped at {skipped}"
+    out = eng.finished
+    assert set(out) == set(rids)
+    # The tiny budget really did defer work across ticks...
+    assert eng.stats["chunk_deferrals"] > 0
+    # ...and chunks genuinely interleaved with live decode windows.
+    assert eng.stats["chunk_windows_interleaved"] > 0
+
+
+# -- allocator conservation through mid-prefill teardown -------------------
+
+
+def _tick_until_mid_prefill(eng):
+    """Advance the pipelined scheduler until some row is mid-prefill."""
+    for _ in range(50):
+        eng.pipeline_tick()
+        mid = [
+            r for r in eng.rows
+            if r is not None and r.prefill_pos is not None
+        ]
+        if mid:
+            return mid[0]
+    raise AssertionError("no row ever entered the mid-prefill phase")
+
+
+@pytest.mark.parametrize("cache", [False, True])
+def test_cancel_mid_prefill_conserves_blocks(params, cache):
+    """Cancelling a request whose prompt is only partially streamed must
+    free (or cache-publish) exactly the blocks it held: after the drain,
+    idle + cold-cached == n_blocks - 1 and a cache flush returns every
+    block to the free list."""
+    n_blocks = 32
+    prompts = _prompts(3, lengths=(23, 5, 19))
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, pipeline_depth=2,
+        prefill_chunk_tokens=3, max_batch=2, n_blocks=n_blocks,
+        block_size=8, prefix_cache=cache,
+    )
+    rids = [eng.submit(p, 6) for p in prompts]
+    victim = _tick_until_mid_prefill(eng)
+    assert 0 < victim.prefill_pos < len(victim.prompt)
+    assert eng.cancel(victim.rid)
+    out = eng.run(pipeline=True)
+    assert set(out) == set(rids) - {victim.rid}
+    for rid, p in zip(rids, prompts):
+        if rid != victim.rid:
+            assert out[rid] == _reference_greedy(params, CFG, p, 6)
+    cold = eng.prefix_cache.evictable if cache else 0
+    assert eng.alloc.available + cold == n_blocks - 1
+    if cache:
+        eng.prefix_cache.flush()
+        assert eng.alloc.available == n_blocks - 1
+
+
+def test_preemption_mid_decode_with_chunking_conserves_blocks(params):
+    """A pool too small for both rows' growth forces preemption while the
+    chunk lane is active: recompute-on-resume must re-stream the victim's
+    committed prompt+tokens through chunks and still match the reference
+    greedy, with the allocator fully accounted for at drain."""
+    n_blocks = 8
+    prompts = _prompts(2, lengths=(12, 10))
+    n_new = 24
+    on, eng = _run(
+        params, prompts, n_new, chunk=4, depth=2,
+        max_batch=2, n_blocks=n_blocks, block_size=8, steps_per_sched=4,
+    )
+    assert eng.stats["preemptions"] >= 1
+    for got, p in zip(on, prompts):
+        assert got == _reference_greedy(params, CFG, p, n_new)
+    assert eng.alloc.available == n_blocks - 1
+    # Rework accounting: the resumed prompt's re-streamed tokens are
+    # counted as recompute, not fresh prefill demand.
+    assert eng.stats["preempted_tokens_recomputed"] > 0
+
+
+# -- pinned {chunk, tail} shapes: zero recompiles once warm ----------------
+
+
+def test_no_recompiles_for_novel_prompt_lengths_once_warm(params):
+    """Monolithic prefill compiled one program per prompt-length bucket;
+    the chunk lane pins every dispatch to the SAME (row-bucket, chunk)
+    shape — tails pad into the chunk bucket — so an engine warmed on a
+    handful of lengths serves arbitrary novel lengths with zero new
+    compiles."""
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, pipeline_depth=2,
+        prefill_chunk_tokens=8, max_batch=2, n_blocks=48, block_size=8,
+    )
+    # Warm: a solo request (row-bucket 1), then a full batch (row-bucket
+    # 2) — covers every group shape the steady state can produce.
+    r0 = eng.submit(_prompts(1, lengths=(11,))[0], 6)
+    eng.run(pipeline=True)
+    warm_prompts = _prompts(3, lengths=(17, 9, 21))
+    for p in warm_prompts:
+        eng.submit(p, 6)
+    eng.run(pipeline=True)
+    assert r0 in eng.finished
+
+    w = CompileWatcher().start()
+    try:
+        before = w.summary()["compiles"]
+        # Novel lengths (never seen above), served both solo and batched.
+        novel = _prompts(4, lengths=(13, 26, 7, 18))
+        rids = [eng.submit(p, 6) for p in novel]
+        out = eng.run(pipeline=True)
+        assert set(rids) <= set(out)
+        assert w.summary()["compiles"] == before, (
+            "novel prompt lengths recompiled the chunk lane"
+        )
+    finally:
+        w.stop()
+    for rid, p in zip(rids, novel):
+        assert out[rid] == _reference_greedy(params, CFG, p, 6)
+
+
+# -- observability: spans, waterfall, metrics, decision join ---------------
+
+
+def test_chunk_spans_waterfall_metrics_and_decision_join(params):
+    """The full observability wiring of the chunk lane through a traced
+    EngineLoop: every done trace tree is complete with `req.prefill_chunk`
+    spans standing in for the monolithic prefill span, the waterfall grows
+    a `chunked_prefill_s` segment that still sums to e2e within 1%, the
+    typed chunk counters land in /metrics, and every `defer_prefill_chunk`
+    decision joins to a known trace (the --capacity --strict contract)."""
+    from pretraining_llm_tpu.frontend.admission import AdmissionController
+    from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+    from pretraining_llm_tpu.observability.events import EventBus
+    from pretraining_llm_tpu.observability.export import lint_exposition
+    from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+    from pretraining_llm_tpu.observability.spans import SpanRecorder
+    from pretraining_llm_tpu.observability.tracing import Tracer
+
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, pipeline_depth=2,
+        prefill_chunk_tokens=2, max_batch=2, n_blocks=48, block_size=8,
+        steps_per_sched=2,
+    )
+    recorder = SpanRecorder()
+    registry = MetricsRegistry("pllm_serving_")
+    with EngineLoop(
+        eng, admission=AdmissionController(max_queue_depth=8),
+        bus=EventBus(), tracer=Tracer(recorder, sample=1.0, seed=5),
+        registry=registry,
+    ) as loop:
+        handles = [loop.submit(p, 6) for p in _prompts(4, lengths=(19, 23, 16, 21))]
+        for h in handles:
+            assert h.result(timeout=300)[0] == "done"
+        metrics_text = registry.render(extra_gauges=loop.metrics())
+        defers = [
+            r for r in eng.decisions.tail()
+            if r["decision"] == "defer_prefill_chunk"
+        ]
+
+    trace = recorder.to_chrome_trace()
+    groups = obs_report.group_request_spans(trace)
+    assert len(groups) == 4
+    saw_chunk_segment = False
+    for tid, spans in groups.items():
+        assert obs_report.check_trace_tree(tid, spans) == [], tid
+        names = {s["name"] for s in spans}
+        assert "req.prefill_chunk" in names
+        assert "req.prefill" not in names  # the lane fully replaced it
+        wf = obs_report.request_waterfall(tid, spans)
+        assert abs(wf["sum_error_s"]) <= max(1e-6, 0.01 * wf["e2e_s"])
+        if wf["segments"]["chunked_prefill_s"] > 0:
+            saw_chunk_segment = True
+    assert saw_chunk_segment
+
+    assert lint_exposition(metrics_text) == []
+    for counter, want in (
+        ("prefill_chunks_total", eng.stats["prefill_chunks"]),
+        ("prefill_chunk_tokens_total", eng.stats["prefill_chunk_tokens"]),
+        ("chunk_windows_interleaved_total",
+         eng.stats["chunk_windows_interleaved"]),
+        ("chunk_windows_dedicated_total",
+         eng.stats["chunk_windows_dedicated"]),
+    ):
+        assert f"pllm_serving_{counter} {float(want)}" in metrics_text, counter
+    assert eng.stats["prefill_chunks"] > 0
+
+    # The 2-token budget against 16+ token prompts MUST have deferred,
+    # and every deferral names a trace the span export knows — the join
+    # obs_report --capacity --strict enforces.
+    assert defers
+    for rec in defers:
+        assert rec["trace_id"] in groups, rec
+
+
+# -- knob validation -------------------------------------------------------
+
+
+def test_negative_chunk_tokens_rejected(params):
+    with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+        ServingEngine(
+            params, CFG, max_batch=2, n_blocks=16, block_size=8,
+            prefill_chunk_tokens=-1,
+        )
+
+
+def test_serving_config_chunk_knob():
+    from pretraining_llm_tpu.config import ServingConfig
+
+    assert ServingConfig().prefill_chunk_tokens == 0
+    assert ServingConfig(prefill_chunk_tokens=64).prefill_chunk_tokens == 64
+    with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+        ServingConfig(prefill_chunk_tokens=-2)

@@ -1,0 +1,210 @@
+"""Ragged paged-attention kernel vs its XLA gather fallback.
+
+The ragged kernel (ops/pallas_ragged.py) serves a batch whose rows carry
+HETEROGENEOUS query counts — decode rows (q_len 1) and prefill-chunk
+rows (q_len up to the chunk budget) in one launch — against the same
+fragmented block pool as the uniform kernel. `ragged_gather_attention`
+is the one source of truth for the mask/softmax numerics; the kernel
+(interpret mode on CPU, same convention as test_pallas_paged) must agree
+to accumulation-order tolerance for every (mix, GQA, window, dtype)
+combination, including qlen=0 padding rows and pad-query zeroing.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.ops.pallas_ragged import (
+    ragged_gather_attention,
+    ragged_paged_attention,
+)
+
+
+def _random_state(rng, b, n_blocks, max_blocks, bs, t):
+    """Fragmented tables + a ragged q_len per row: each row owns a random
+    disjoint set of pages and a committed offset that leaves room for its
+    own query count (the engine's page horizon guarantees this live)."""
+    perm = rng.permutation(np.arange(1, n_blocks)).tolist()
+    tables = np.zeros((b, max_blocks), np.int32)
+    seq = np.zeros((b,), np.int32)
+    qlens = np.zeros((b,), np.int32)
+    for i in range(b):
+        n_pages = int(rng.integers(1, max_blocks + 1))
+        own = [perm.pop() for _ in range(n_pages)]
+        tables[i, : len(own)] = own
+        qlens[i] = int(rng.integers(1, t + 1))
+        cap = n_pages * bs - int(qlens[i])
+        seq[i] = int(rng.integers(0, max(cap, 0) + 1))
+    return tables, seq, qlens
+
+
+def _mixed_batch(rng, b, t, h, d, dtype=jnp.float32):
+    """Half decode rows (q_len 1), half chunk rows (q_len up to t) — the
+    launch shape chunked prefill actually produces."""
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    qlens = np.asarray(
+        [1 if i % 2 == 0 else int(rng.integers(2, t + 1)) for i in range(b)],
+        np.int32,
+    )
+    return q, qlens
+
+
+@pytest.mark.parametrize("g,window", [(8, 0), (2, 0), (4, 12), (1, 0)])
+def test_ragged_kernel_matches_gather(g, window):
+    rng = np.random.default_rng(g * 100 + window)
+    b, t, h, d, bs, n_blocks, max_blocks = 3, 6, 8, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq, qlens = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens),
+        window=window,
+    )
+    assert out.shape == (b, t, h, d)
+    ref = ragged_gather_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens),
+        window=window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("g", [4, 2])
+def test_ragged_mixed_decode_and_chunk_rows(g):
+    """The production mix: decode rows (q_len 1) share a launch with
+    chunk rows; each row must get exactly the uniform-kernel answer it
+    would get alone."""
+    rng = np.random.default_rng(g)
+    b, t, h, d, bs, n_blocks, max_blocks = 4, 8, 8, 64, 8, 32, 6
+    q, qlens = _mixed_batch(rng, b, t, h, d)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq, _ = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    seq = np.minimum(seq, max_blocks * bs - t)
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    )
+    ref = ragged_gather_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # Per-row check against the gather ref evaluated for that row ALONE:
+    # raggedness must not leak numerics across rows.
+    for i in range(b):
+        solo = ragged_gather_attention(
+            q[i : i + 1], kp, vp, jnp.asarray(tables[i : i + 1]),
+            jnp.asarray(seq[i : i + 1]), jnp.asarray(qlens[i : i + 1]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[i : i + 1]), np.asarray(solo), atol=2e-5
+        )
+
+
+def test_ragged_pad_queries_zero_and_qlen_zero_row():
+    """Pad queries (t >= q_lens[b]) and fully-padded rows (q_len 0, the
+    launch-width remainder) must come back exactly zero — the caller
+    discards them, but NaNs would poison reductions downstream."""
+    rng = np.random.default_rng(5)
+    b, t, h, g, d, bs = 3, 4, 4, 2, 64, 8
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(8, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(8, bs, g, d)), jnp.float32)
+    tables = np.asarray([[3, 0], [5, 6], [7, 0]], np.int32)
+    seq = np.asarray([0, bs, 3], np.int32)
+    qlens = np.asarray([2, 4, 0], np.int32)
+    out = np.asarray(ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    ))
+    ref = np.asarray(ragged_gather_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    ))
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[0, 2:], 0.0)  # pad queries of row 0
+    np.testing.assert_array_equal(out[2], 0.0)  # fully-padded row
+
+
+def test_ragged_kernel_bf16():
+    rng = np.random.default_rng(7)
+    b, t, h, g, d, bs, n_blocks, max_blocks = 2, 5, 4, 2, 64, 8, 12, 3
+    q, qlens = _mixed_batch(rng, b, t, h, d, jnp.bfloat16)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.bfloat16)
+    tables, seq, _ = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    seq = np.minimum(seq, max_blocks * bs - t)
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = ragged_gather_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+def test_ragged_matches_uniform_reference_on_uniform_batch():
+    """With every q_len == t the ragged mask degenerates to the uniform
+    multi-token mask — pin it against test_pallas_paged's reference math
+    (inlined here) so the two kernels can never drift apart."""
+    import jax
+
+    rng = np.random.default_rng(13)
+    b, t, h, g, d, bs, n_blocks, max_blocks = 2, 4, 8, 4, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq, _ = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    seq = np.minimum(seq, max_blocks * bs - t)
+    qlens = np.full((b,), t, np.int32)
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens)
+    )
+    kv_len = max_blocks * bs
+    n_rep = h // g
+    ck = jnp.repeat(kp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
+    cv = jnp.repeat(vp[tables].reshape(b, kv_len, g, d), n_rep, axis=2)
+    lin = jnp.arange(kv_len)
+    pos = seq[:, None] + jnp.arange(t)[None, :]
+    mask = lin[None, None, :] <= pos[:, :, None]
+    s = jnp.einsum(
+        "bthd,bkhd->bthk", q.astype(jnp.float32), ck.astype(jnp.float32)
+    ) / np.sqrt(d)
+    s = jnp.where(mask[:, :, None, :], s, -1e30)
+    ref = jnp.einsum(
+        "bthk,bkhd->bthd", jax.nn.softmax(s, axis=-1), cv.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_validation():
+    q3 = jnp.zeros((2, 4, 64))
+    kp = jnp.zeros((8, 8, 2, 64))
+    ql = jnp.ones((2,), jnp.int32)
+    with pytest.raises(ValueError, match="B, T, H, Dh"):
+        ragged_paged_attention(
+            q3, kp, kp, jnp.zeros((2, 2), jnp.int32),
+            jnp.zeros((2,), jnp.int32), ql,
+        )
+    q = jnp.zeros((2, 3, 4, 64))
+    with pytest.raises(ValueError, match="divide"):
+        ragged_paged_attention(
+            q, jnp.zeros((8, 8, 3, 64)), jnp.zeros((8, 8, 3, 64)),
+            jnp.zeros((2, 2), jnp.int32), jnp.zeros((2,), jnp.int32), ql,
+        )
+    with pytest.raises(ValueError, match="batch"):
+        ragged_paged_attention(
+            q, kp, kp, jnp.zeros((3, 2), jnp.int32),
+            jnp.zeros((3,), jnp.int32), jnp.ones((3,), jnp.int32),
+        )
+    with pytest.raises(ValueError, match="q_lens"):
+        ragged_paged_attention(
+            q, kp, kp, jnp.zeros((2, 2), jnp.int32),
+            jnp.zeros((2,), jnp.int32), jnp.ones((3,), jnp.int32),
+        )
+    with pytest.raises(ValueError, match="mismatch"):
+        ragged_paged_attention(
+            q, kp, jnp.zeros((8, 8, 2, 32)), jnp.zeros((2, 2), jnp.int32),
+            jnp.zeros((2,), jnp.int32), ql,
+        )
