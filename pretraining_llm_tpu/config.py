@@ -766,6 +766,14 @@ class ServingConfig:
     # TPOT is protected. 0 disables (monolithic prefill at admission);
     # greedy outputs are bit-identical either way.
     prefill_chunk_tokens: int = 0
+    # KV-page integrity checksums (resilience/integrity.py): record a
+    # digest of each published prefix-cache block's pool bytes and verify
+    # it when a later request acquires the block — a corrupted shared page
+    # is dropped and that request re-prefills privately instead of every
+    # future hit inheriting the poison. Digests pull page bytes only at
+    # publish/acquire boundaries, never per decode window. Off by default
+    # (the zero-device-sync path).
+    kv_checksum: bool = False
 
     def __post_init__(self) -> None:
         if self.pipeline_depth < 1:
@@ -866,6 +874,23 @@ class FrontendConfig:
     # tests; decorrelates client retry herds in prod).
     retry_jitter_frac: float = 0.25
     retry_jitter_seed: int = 0
+    # ---- output-integrity sentinel (resilience/integrity.py). All off
+    # by default: probes, fingerprints, and checksums add zero device
+    # work until a knob turns them on. ---------------------------------
+    # Golden-probe period: every interval the router injects a pinned
+    # greedy probe into each active replica at strict-lowest priority and
+    # quarantines any replica whose output diverges from the reference
+    # pinned at startup. 0 disables the sentinel entirely.
+    probe_interval_s: float = 0.0
+    # How many distinct probes to pin (round-robined across intervals).
+    probe_count: int = 2
+    # Tokens each probe decodes; longer probes catch subtler divergence
+    # at proportionally higher (lowest-priority) cost.
+    probe_max_new: int = 4
+    # Per-replica weight fingerprint recompute period (computed on each
+    # loop thread between scheduler turns; compared by the sentinel
+    # against the value pinned at launch). 0 disables.
+    weight_fingerprint_interval_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not 0 <= self.port <= 65535:
@@ -944,6 +969,23 @@ class FrontendConfig:
             raise ValueError(
                 "retry_jitter_frac must be in [0, 1], got "
                 f"{self.retry_jitter_frac}"
+            )
+        if self.probe_interval_s < 0:
+            raise ValueError(
+                f"probe_interval_s must be >= 0, got {self.probe_interval_s}"
+            )
+        if self.probe_count < 1:
+            raise ValueError(
+                f"probe_count must be >= 1, got {self.probe_count}"
+            )
+        if self.probe_max_new < 1:
+            raise ValueError(
+                f"probe_max_new must be >= 1, got {self.probe_max_new}"
+            )
+        if self.weight_fingerprint_interval_s < 0:
+            raise ValueError(
+                "weight_fingerprint_interval_s must be >= 0, got "
+                f"{self.weight_fingerprint_interval_s}"
             )
 
 

@@ -118,6 +118,7 @@ class EngineLoop:
         tracer: Any = None,
         registry: Any = None,
         capacity_ring: int = 512,
+        weight_fingerprint_interval_s: float = 0.0,
     ) -> None:
         self.engine = engine
         self.admission = admission
@@ -186,6 +187,12 @@ class EngineLoop:
                     "requests shed on deadline grounds", kind=kind)
                 for kind in ("admission", "inflight")
             }
+            engine.invalid_token_counter = registry.counter(
+                "invalid_token_total",
+                "out-of-vocab token ids caught by the reap sanity guard")
+            engine.kv_mismatch_counter = registry.counter(
+                "kv_checksum_mismatch_total",
+                "cached KV pages that failed verify-on-acquire")
         else:
             self._c_shed = {}
         # Capacity observability (observability/capacity.py): occupancy
@@ -239,6 +246,21 @@ class EngineLoop:
         # the fleet router reads it to distinguish "crashed" from
         # "stopped" without parsing terminal reasons.
         self.failure: Optional[BaseException] = None
+        # Live weight fingerprint (resilience/integrity.py). Both values are
+        # computed ON the loop thread — the only thread allowed to dispatch
+        # device work for this engine — and merely READ by the router's
+        # sentinel: ``weight_fingerprint0`` is pinned once at loop start (the
+        # known-good reference), ``weight_fingerprint`` is refreshed every
+        # ``weight_fingerprint_interval_s`` between scheduler turns. 0
+        # disables the layer (both stay None; no device work added).
+        if weight_fingerprint_interval_s < 0:
+            raise ValueError(
+                f"weight_fingerprint_interval_s must be >= 0, got "
+                f"{weight_fingerprint_interval_s}"
+            )
+        self.weight_fingerprint_interval_s = float(weight_fingerprint_interval_s)
+        self.weight_fingerprint0: Optional[float] = None
+        self.weight_fingerprint: Optional[float] = None
         self._draining = False
         self.counters: Dict[str, int] = {
             "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
@@ -610,6 +632,15 @@ class EngineLoop:
     def _run(self) -> None:
         eng = self.engine
         failure: Optional[BaseException] = None
+        fp_interval = self.weight_fingerprint_interval_s
+        last_fp = self._clock()
+        if fp_interval > 0:
+            # Pin the known-good reference before serving the first request.
+            # Both the pin and every periodic refresh run HERE so the device
+            # reduction stays on the one thread that owns engine dispatch.
+            from pretraining_llm_tpu.resilience.integrity import weight_fingerprint
+            self.weight_fingerprint0 = weight_fingerprint(eng.params)
+            self.weight_fingerprint = self.weight_fingerprint0
         try:
             while True:
                 self._wake.clear()
@@ -624,11 +655,21 @@ class EngineLoop:
                     # deadlines; apply before the next dispatch extends them.
                     self._apply_cancels_and_deadlines()
                 self._last_turn = self._clock()
+                if fp_interval > 0 and self._clock() - last_fp >= fp_interval:
+                    self.weight_fingerprint = weight_fingerprint(eng.params)
+                    last_fp = self._clock()
                 if not busy and self._inbox.empty() and not self._stop.is_set():
                     self._wake.wait(self.idle_wait_s)
         except BaseException as e:
             failure = e
             self.failure = e
+            from pretraining_llm_tpu.resilience.integrity import IntegrityError
+            if self.bus is not None and isinstance(e, IntegrityError):
+                self.bus.emit(
+                    "integrity_invalid_token",
+                    rid=getattr(e, "rid", None),
+                    token=getattr(e, "token", None),
+                )
             raise
         finally:
             # Runs on clean stop() AND when the engine (or a hook) raised:
@@ -643,7 +684,16 @@ class EngineLoop:
             )
             try:
                 # Drain device state so nothing is mid-write, then fail
-                # the survivors loudly.
+                # the survivors loudly. A FAILED engine's flush must not
+                # stream or complete anything (after an integrity trip the
+                # commit stream is exactly what can't be trusted — e.g. the
+                # reap that raised already advanced past the bad token, so
+                # later windows would skip a position): mute the callbacks
+                # and let every request take the error terminal below,
+                # which redrives it from its last CLEAN committed frontier.
+                if failure is not None:
+                    eng.on_token = None
+                    eng.on_finish = None
                 eng._flush_inflight()
             except Exception:
                 pass  # the engine is already broken; still fail survivors

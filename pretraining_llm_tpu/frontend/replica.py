@@ -153,6 +153,10 @@ class Replica:
             engine.pipeline_tick = self.faults.wrap_tick(
                 self.index, engine.pipeline_tick
             )
+            # Corruption faults (corrupt_kv_page/corrupt_weights/wrong_token)
+            # mutate engine state directly; re-attached on every relaunch so
+            # the injector never fires into a stopped engine's generation.
+            self.faults.attach_engine(self.index, engine)
         admission = (
             self._admission_factory(self.registry)
             if self._admission_factory is not None

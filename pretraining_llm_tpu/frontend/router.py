@@ -175,6 +175,11 @@ class Router:
         brownout_min_healthy_frac: float = 0.0,
         brownout_min_priority: int = 1,
         brownout_max_deadline_s: float = 0.0,
+        probe_interval_s: float = 0.0,
+        probe_count: int = 2,
+        probe_max_new: int = 4,
+        probe_timeout_s: float = 30.0,
+        probe_set: Optional[List[Any]] = None,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -190,6 +195,20 @@ class Router:
             raise ValueError(
                 f"brownout_min_healthy_frac must be in [0, 1], got "
                 f"{brownout_min_healthy_frac}"
+            )
+        if probe_interval_s < 0:
+            raise ValueError(
+                f"probe_interval_s must be >= 0, got {probe_interval_s}"
+            )
+        if probe_count < 1:
+            raise ValueError(f"probe_count must be >= 1, got {probe_count}")
+        if probe_max_new < 1:
+            raise ValueError(
+                f"probe_max_new must be >= 1, got {probe_max_new}"
+            )
+        if probe_timeout_s <= 0:
+            raise ValueError(
+                f"probe_timeout_s must be > 0, got {probe_timeout_s}"
             )
         self.replicas = list(replicas)
         self.admission = admission
@@ -207,6 +226,24 @@ class Router:
         self.brownout_min_healthy_frac = float(brownout_min_healthy_frac)
         self.brownout_min_priority = int(brownout_min_priority)
         self.brownout_max_deadline_s = float(brownout_max_deadline_s)
+        # Output-integrity sentinel (resilience/integrity.py). 0 disables
+        # the layer entirely: no probe set is built, the health loop never
+        # probes, and no fingerprint is read. ``probe_set`` lets tests
+        # inject pinned probes; production pins them in start() from the
+        # first replica's reference greedy path.
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_count = int(probe_count)
+        self.probe_max_new = int(probe_max_new)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._probe_set: Optional[List[Any]] = (
+            list(probe_set) if probe_set is not None else None
+        )
+        self._probe_lock = threading.Lock()
+        self._probe_inflight: Set[int] = set()
+        self._last_probe_ok: Dict[int, bool] = {}
+        self._last_probe_t: Dict[int, float] = {}
+        self._probe_idx = 0
+        self._next_probe_at = 0.0
         self.decisions = DecisionLog(maxlen=256, bus=bus)
         self._live: Dict[int, RouterRequest] = {}
         self._live_lock = threading.Lock()
@@ -222,9 +259,11 @@ class Router:
         self.counters: Dict[str, int] = {
             "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
             "errors": 0, "redrives": 0, "brownout_shed": 0, "ejects": 0,
+            "probes": 0, "probe_failures": 0, "quarantines": 0,
         }
         self._g_state: Dict[int, Any] = {}
         self._c_redrives = self._c_shed = self._c_ejects = None
+        self._c_probes = self._c_probe_fail = self._c_quarantines = None
         self._g_brownout = None
         if registry is not None:
             for rep in self.replicas:
@@ -244,6 +283,15 @@ class Router:
                 "replicas declared dead/wedged by the health loop")
             self._g_brownout = registry.gauge(
                 "brownout_active", "1 while the fleet is in brownout")
+            self._c_probes = registry.counter(
+                "integrity_probes_total",
+                "golden probes completed against replicas")
+            self._c_probe_fail = registry.counter(
+                "integrity_probe_failures_total",
+                "golden probes whose output diverged from the pinned reference")
+            self._c_quarantines = registry.counter(
+                "quarantines_total",
+                "replicas quarantined by the integrity sentinel")
         for rep in self.replicas:
             rep.on_state = self._on_replica_state
 
@@ -253,6 +301,69 @@ class Router:
         for rep in self.replicas:
             if rep.loop is None:
                 rep.start()
+        if self.probe_interval_s > 0 and self._probe_set is None:
+            # Pin the golden set once, from the REFERENCE generate path on
+            # known-good weights (the loops are idle at this point; no
+            # request has touched any engine yet). probe_len spans exactly
+            # one full KV block past the boundary so probe #0 publishes the
+            # shared prefix to the prefix cache and every later probe
+            # re-acquires it — a corrupted cached page then surfaces as
+            # probe divergence, not just as wrong client outputs.
+            from pretraining_llm_tpu.resilience.integrity import (
+                GoldenProbe, build_probe_set,
+            )
+            engine = next(
+                (r.engine for r in self.replicas if r.engine is not None),
+                None,
+            )
+            if engine is None:
+                raise RuntimeError(
+                    "probe_interval_s > 0 needs a launched replica to pin "
+                    "the golden probe set against"
+                )
+            if engine.temperature != 0.0:
+                raise ValueError(
+                    "golden probes compare outputs bit-for-bit and need "
+                    "deterministic decode: probe_interval_s > 0 requires "
+                    f"temperature=0, got {engine.temperature} (a sampling "
+                    "engine draws fresh noise per decode, so every probe "
+                    "would diverge and quarantine healthy replicas)"
+                )
+            # Clamp to the model context: a large serving block size on a
+            # short-context model must not make the probe itself infeasible
+            # (the cache-coverage property just degrades to a partial page).
+            probe_len = min(
+                engine.block_size + 1,
+                engine.cfg.context_length - self.probe_max_new,
+            )
+            if probe_len < 2:
+                raise ValueError(
+                    f"context_length={engine.cfg.context_length} leaves no "
+                    f"room for a probe with probe_max_new="
+                    f"{self.probe_max_new}"
+                )
+            self._probe_set = build_probe_set(
+                engine.params, engine.cfg,
+                n_probes=self.probe_count,
+                probe_len=probe_len,
+                max_new=self.probe_max_new,
+            )
+            # Re-pin the expected tokens from the SERVING path itself. The
+            # reference generate above vets the prompts, but at bf16 its
+            # argmax near-ties can legitimately differ from the paged
+            # serving engine's — a baseline from a different code path
+            # would quarantine every healthy replica. Serving is
+            # deterministic and identical across same-config replicas, so
+            # the unanimous startup answer is the bit-exact contract every
+            # healthy replica must keep; replicas that disagree before any
+            # traffic means no trustworthy baseline exists at all.
+            self._probe_set = [
+                GoldenProbe(prompt=p.prompt, expected=exp)
+                for p, exp in zip(
+                    self._probe_set,
+                    self._pin_serving_baseline(self._probe_set),
+                )
+            ]
         self._health_thread = threading.Thread(
             target=self._health_loop, name="router-health", daemon=True
         )
@@ -678,6 +789,7 @@ class Router:
                             self._relaunch_at[rep.index] = (
                                 self._clock() + backoff
                             )
+            self._sentinel_tick(now)
             self._update_brownout()
 
     def _next_backoff(self, index: int) -> float:
@@ -698,6 +810,189 @@ class Router:
         backoff = self._next_backoff(rep.index)
         self._relaunch_at[rep.index] = self._clock() + backoff
         self._redrive_from(rep.index, reason)
+
+    def _pin_serving_baseline(
+        self, probes: List[Any]
+    ) -> List[Tuple[int, ...]]:
+        """Decode every probe on every launched replica (idle at startup)
+        and return the unanimous answers. Runs before the health thread
+        starts, so plain blocking waits are fine."""
+        live = [r for r in self.replicas if r.loop is not None]
+        expected: List[Tuple[int, ...]] = []
+        for probe in probes:
+            per_probe: List[Tuple[int, Tuple[int, ...]]] = []
+            for rep in live:
+                attempt = rep.loop.submit(
+                    list(probe.prompt), len(probe.expected), priority=-1,
+                )
+                try:
+                    status, tokens, _info = attempt.result(
+                        timeout=self.probe_timeout_s
+                    )
+                except TimeoutError:
+                    raise RuntimeError(
+                        f"replica {rep.index} did not answer a golden "
+                        f"probe within {self.probe_timeout_s}s at startup; "
+                        "cannot pin an integrity baseline"
+                    )
+                if status != "done":
+                    raise RuntimeError(
+                        f"replica {rep.index} failed a golden probe at "
+                        f"startup (status={status!r}); cannot pin an "
+                        "integrity baseline"
+                    )
+                per_probe.append((rep.index, tuple(tokens)))
+            base = per_probe[0][1]
+            diverged = [i for i, t in per_probe if t != base]
+            if diverged:
+                raise RuntimeError(
+                    "replicas disagree on a golden probe before any "
+                    f"traffic (replica {per_probe[0][0]} vs {diverged}); "
+                    "no trustworthy integrity baseline exists"
+                )
+            expected.append(base)
+        return expected
+
+    # -- integrity sentinel --------------------------------------------------
+    #
+    # Runs on the health thread. Two detectors per tick: (1) the live
+    # weight fingerprint each loop thread computes between turns, compared
+    # against the value it pinned at launch — drift means the weights the
+    # replica is SERVING are not the weights it started with; (2) golden
+    # probes — pinned greedy (prompt -> tokens) pairs injected through the
+    # normal admission lane at strict-lowest priority, one outstanding per
+    # replica, outputs compared bit-for-bit against the reference. Either
+    # detector firing quarantines the replica: pull it from service via
+    # the eject machinery (redrive its in-flight work onto survivors,
+    # relaunch with fresh weights from the factory after backoff).
+    # Quarantine means "the replica answered WRONG" — a probe that errors,
+    # expires, or times out is recorded but left to the health checks
+    # above, which own "the replica didn't answer".
+
+    def _sentinel_tick(self, now: float) -> None:
+        if self.probe_interval_s <= 0 or self._probe_set is None:
+            return
+        for rep in self.replicas:
+            loop = rep.loop
+            if rep.state != "active" or loop is None:
+                continue
+            fp0 = loop.weight_fingerprint0
+            fp = loop.weight_fingerprint
+            if fp0 is not None and fp is not None and fp != fp0:
+                if self.bus is not None:
+                    self.bus.emit(
+                        "integrity_weight_mismatch", replica=rep.index,
+                        pinned=fp0, current=fp,
+                        fleet={
+                            str(r.index): r.loop.weight_fingerprint
+                            for r in self.replicas if r.loop is not None
+                        },
+                    )
+                self._quarantine(
+                    rep,
+                    f"weight fingerprint drift ({fp0!r} -> {fp!r})",
+                    None,
+                )
+        if now < self._next_probe_at:
+            return
+        self._next_probe_at = now + self.probe_interval_s
+        probe = self._probe_set[self._probe_idx % len(self._probe_set)]
+        self._probe_idx += 1
+        for rep in self.replicas:
+            loop = rep.loop
+            if rep.state != "active" or loop is None or loop.draining:
+                continue
+            with self._probe_lock:
+                if rep.index in self._probe_inflight:
+                    continue  # one outstanding probe per replica
+                self._probe_inflight.add(rep.index)
+            generation = rep.generation
+            try:
+                # Straight to the loop: probes must not consume fleet
+                # admission budget or count as client traffic (frid
+                # conservation, fault clocks). priority=-1 is below every
+                # client request, so brownout-style shedding hits probes
+                # first. A busy replica skips this round — probes yield.
+                attempt = loop.submit(
+                    list(probe.prompt), len(probe.expected), priority=-1,
+                )
+            except Exception:
+                with self._probe_lock:
+                    self._probe_inflight.discard(rep.index)
+                continue
+            threading.Thread(
+                target=self._probe_pump,
+                args=(rep, attempt, probe, generation),
+                name=f"probe-{rep.index}",
+                daemon=True,
+            ).start()
+
+    def _probe_pump(
+        self, rep: Replica, attempt: FrontendRequest, probe: Any,
+        generation: int,
+    ) -> None:
+        try:
+            status, tokens, _info = attempt.result(
+                timeout=self.probe_timeout_s
+            )
+        except TimeoutError:
+            # Wedge/overload territory — the health loop's verdict, not
+            # the sentinel's. Cancel so the probe can't complete into a
+            # replaced inflight slot later.
+            status, tokens = "timeout", []
+            loop = rep.loop
+            if loop is not None:
+                try:
+                    loop.cancel(attempt)
+                except Exception:
+                    pass
+        finally:
+            with self._probe_lock:
+                self._probe_inflight.discard(rep.index)
+        ok = status == "done" and list(tokens) == list(probe.expected)
+        with self._probe_lock:
+            self._last_probe_ok[rep.index] = ok
+            self._last_probe_t[rep.index] = self._clock()
+        with self._counters_lock:
+            self.counters["probes"] += 1
+            if not ok:
+                self.counters["probe_failures"] += 1
+        if self._c_probes is not None:
+            self._c_probes.inc()
+        if not ok and self._c_probe_fail is not None:
+            self._c_probe_fail.inc()
+        trace = getattr(attempt, "trace", None)
+        trace_id = trace.trace_id if trace is not None else None
+        if self.bus is not None:
+            fields = {"trace_id": trace_id} if trace_id is not None else {}
+            self.bus.emit(
+                "integrity_probe", replica=rep.index, ok=ok, status=status,
+                n_tokens=len(tokens), **fields,
+            )
+        if ok or self._stopping:
+            return
+        if status != "done":
+            return  # didn't answer — the health loop owns that verdict
+        if rep.state != "active" or rep.generation != generation:
+            return  # already ejected/relaunched under this probe
+        self._quarantine(rep, "probe divergence", trace_id)
+
+    def _quarantine(
+        self, rep: Replica, reason: str, trace_id: Optional[str]
+    ) -> None:
+        with self._counters_lock:
+            self.counters["quarantines"] += 1
+        if self._c_quarantines is not None:
+            self._c_quarantines.inc()
+        self.decisions.record(
+            "quarantine", replica=rep.index, reason=reason,
+            generation=rep.generation, trace_id=trace_id,
+        )
+        if self.bus is not None:
+            self.bus.emit(
+                "integrity_quarantine", replica=rep.index, reason=reason,
+            )
+        self._eject(rep, f"quarantine: {reason}")
 
     def drain(self, index: int, *, stop_timeout: float = 5.0) -> bool:
         """Administrative drain: stop routing to the replica, redrive its
@@ -773,14 +1068,46 @@ class Router:
             return max(0.0, self._clock() - self._started)
         return min(ages)
 
+    def _integrity_snapshot(self) -> Dict[str, Any]:
+        """Sentinel state for /readyz and /debug/engine: per-replica last
+        probe verdict + age, quarantine count, fingerprint pair."""
+        now = self._clock()
+        with self._probe_lock:
+            ok = dict(self._last_probe_ok)
+            at = dict(self._last_probe_t)
+        probes: Dict[str, Any] = {}
+        for rep in self.replicas:
+            rec: Dict[str, Any] = {"ok": ok.get(rep.index)}
+            t = at.get(rep.index)
+            rec["age_s"] = round(now - t, 6) if t is not None else None
+            loop = rep.loop
+            if loop is not None and loop.weight_fingerprint0 is not None:
+                rec["fingerprint_pinned"] = loop.weight_fingerprint0
+                rec["fingerprint"] = loop.weight_fingerprint
+            probes[str(rep.index)] = rec
+        with self._counters_lock:
+            n_quar = self.counters["quarantines"]
+            n_probes = self.counters["probes"]
+            n_fail = self.counters["probe_failures"]
+        return {
+            "enabled": self.probe_interval_s > 0,
+            "probes_run": n_probes,
+            "probes_failed": n_fail,
+            "quarantines": n_quar,
+            "replicas": probes,
+        }
+
     def readiness(self) -> Dict[str, Any]:
         per = {rep.index: rep.state for rep in self.replicas}
         ready = any(rep.accepting for rep in self.replicas)
-        return {
+        out = {
             "ready": ready,
             "replicas": per,
             "brownout": self.brownout_active,
         }
+        if self.probe_interval_s > 0:
+            out["integrity"] = self._integrity_snapshot()
+        return out
 
     def metrics(self) -> Dict[str, float]:
         """Aggregated counter snapshot (the /metrics extra-gauges path):
@@ -868,6 +1195,8 @@ class Router:
         }
         if self.admission is not None:
             out["fleet"]["admission"] = self.admission.snapshot()
+        if self.probe_interval_s > 0:
+            out["fleet"]["integrity"] = self._integrity_snapshot()
         out["replicas"] = {
             str(rep.index): rep.loop.debug_engine()
             for rep in self.replicas

@@ -95,6 +95,15 @@ class PrefixCache:
         # Refcount-0 cached blocks, LRU order (oldest first — evict from
         # the front, re-publish/release at the back).
         self._cold: "OrderedDict[int, bytes]" = OrderedDict()
+        # Optional content checksums (``kv_checksum``): block id -> digest
+        # of the block's POOL BYTES at publish time (the engine computes
+        # them; the cache only stores/serves them). Verified on acquire;
+        # a mismatch drops the block via ``drop_block``.
+        self._checksums: Dict[int, str] = {}
+        # Blocks dropped for corruption while still referenced by live
+        # rows: unreachable from the index already; the final deref frees
+        # them to the allocator instead of re-coldlisting a known-bad page.
+        self._doomed: set = set()
         # Tallies live in the caller's dict (the engine's ``stats``) so
         # serve.py/bench.py records and EngineLoop.metrics() see them for
         # free; typed counters attach via bind().
@@ -204,7 +213,7 @@ class PrefixCache:
         blocks: Sequence[int],
         n_shared: int,
         publish_len: int,
-    ) -> None:
+    ) -> List[int]:
         """Release a finished/preempted/cancelled row's blocks.
 
         ``history`` is the row's prompt + generated tokens; ``blocks`` its
@@ -219,13 +228,15 @@ class PrefixCache:
         already-indexed chain go back to the allocator instead — first
         writer wins, content is identical by construction). Everything
         else — the partial tail block and speculative over-grants — is
-        freed."""
+        freed. Returns the NEWLY published block ids, so a checksumming
+        engine knows exactly which pages to digest."""
         with self._lock:
             for b in blocks[:n_shared]:
                 self._deref(b)
             bs = self.block_size
             n_pub = min(max(publish_len, 0) // bs, len(blocks))
             to_free: List[int] = list(blocks[max(n_shared, n_pub):])
+            published: List[int] = []
             digest = b""
             for j in range(n_pub):
                 digest = self._chain(digest, history[j * bs:(j + 1) * bs])
@@ -238,8 +249,53 @@ class PrefixCache:
                     self._index[digest] = b
                     self._hash_of[b] = digest
                     self._cold[b] = digest  # ref 0, most-recently-used
+                    published.append(b)
             if to_free:
                 self.alloc.free(to_free)
+            self._sync_gauge()
+            return published
+
+    # -- integrity (resilience/integrity.py; ``kv_checksum``) --------------
+
+    def set_checksum(self, block: int, digest: str) -> None:
+        """Record a published block's pool-content digest (engine-computed
+        at publish; see ServingEngine._release_row). Ignored for blocks
+        that already left the index — publish and eviction can race only
+        in the sense that eviction wins."""
+        with self._lock:
+            if block in self._hash_of:
+                self._checksums[block] = digest
+
+    def checksum_of(self, block: int) -> Optional[str]:
+        """The digest recorded at publish, or None (checksumming off when
+        it was published, or the block is gone)."""
+        with self._lock:
+            return self._checksums.get(block)
+
+    def cached_block_ids(self) -> List[int]:
+        """All indexed block ids, sorted (deterministic corruption-drill
+        targeting + integrity sweeps)."""
+        with self._lock:
+            return sorted(self._hash_of)
+
+    def drop_block(self, block: int) -> None:
+        """Remove one block from the cache because its CONTENT failed
+        verification. Unlike ``evict`` this takes a block in any state:
+        a cold block is freed to the allocator immediately; a block still
+        referenced by live rows just becomes unreachable (no future hit
+        can map it) and is freed — not re-coldlisted — on its final
+        deref. Idempotent for already-dropped blocks."""
+        with self._lock:
+            digest = self._hash_of.pop(block, None)
+            if digest is None:
+                return
+            self._index.pop(digest, None)
+            self._checksums.pop(block, None)
+            if block in self._cold:
+                del self._cold[block]
+                self.alloc.free([block])
+            else:
+                self._doomed.add(block)
             self._sync_gauge()
 
     # -- pressure ----------------------------------------------------------
@@ -254,6 +310,7 @@ class PrefixCache:
                 b, digest = self._cold.popitem(last=False)
                 del self._index[digest]
                 del self._hash_of[b]
+                self._checksums.pop(b, None)
                 freed.append(b)
             if freed:
                 self.alloc.free(freed)
@@ -306,6 +363,12 @@ class PrefixCache:
             raise ValueError(f"release of unreferenced block {b}")
         if n == 1:
             del self._ref[b]
-            self._cold[b] = self._hash_of[b]  # most-recently-used end
+            if b in self._doomed:
+                # Dropped for corruption while shared: the last holder is
+                # gone, so the page finally leaves the pool.
+                self._doomed.discard(b)
+                self.alloc.free([b])
+            else:
+                self._cold[b] = self._hash_of[b]  # most-recently-used end
         else:
             self._ref[b] = n - 1

@@ -29,6 +29,16 @@ def sample_logits(
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
+    # Integrity guard (sampling path only — greedy argmax of corrupt
+    # logits still lands in-vocab and the golden probes own that case):
+    # corrupted state surfaces as NaN/+inf logits, and categorical over
+    # them returns an arbitrary IN-RANGE id — silent garbage. Flag such
+    # rows before masking (the top-k/top-p/min-p filters introduce
+    # legitimate -inf) and return -1 for them: out of vocab range, so the
+    # serving engine's reap-time sanity check fails the request loudly
+    # instead of streaming it. Fused elementwise+reduce on the existing
+    # program — no extra sync, no effect on finite logits.
+    bad = jnp.any(jnp.isnan(logits) | (logits == jnp.inf), axis=-1)
     logits = logits / temperature
     if min_p is not None and 0.0 < min_p <= 1.0:
         # Keep tokens whose prob >= min_p * max prob. In logit space:
@@ -46,4 +56,5 @@ def sample_logits(
         cutoff_idx = jnp.sum(cum < top_p, axis=-1)
         cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    sampled = jax.random.categorical(key, logits, axis=-1)
+    return jnp.where(bad, jnp.int32(-1), sampled.astype(jnp.int32))

@@ -146,6 +146,7 @@ class ServingEngine:
         prefill_chunk_tokens: int = 0,
         prefix_cache: bool = False,
         prefix_cache_min_blocks: int = 1,
+        kv_checksum: bool = False,
         mesh: Any = None,
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
@@ -249,6 +250,14 @@ class ServingEngine:
                 f"prefill_chunk_tokens must be >= 0, got {prefill_chunk_tokens}"
             )
         self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        # KV integrity checksums (resilience/integrity.py): record a
+        # content digest of every pool block the prefix cache publishes,
+        # and re-verify it when a later admission acquires the block — a
+        # corrupted shared page is dropped and re-prefilled privately
+        # instead of poisoning every future hit. Off by default: digests
+        # pull page bytes to the host, so the knob buys detection at
+        # publish/acquire boundaries only (never inside decode windows).
+        self.kv_checksum = bool(kv_checksum)
 
         # Sharded serving: params arrive pre-sharded
         # (generate.shard_params_for_inference); the KV pools shard their
@@ -354,6 +363,11 @@ class ServingEngine:
         self.chunk_tokens_counter: Optional[Any] = None
         self.chunk_interleaved_counter: Optional[Any] = None
         self.chunk_dedicated_counter: Optional[Any] = None
+        # Integrity typed counters (bound by the frontend like the rest):
+        # out-of-vocab token ids caught at reap, and cached KV pages that
+        # failed verify-on-acquire.
+        self.invalid_token_counter: Optional[Any] = None
+        self.kv_mismatch_counter: Optional[Any] = None
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         self._admit_counter = 0
@@ -1012,6 +1026,7 @@ class ServingEngine:
         for tok in (int(t) for t in toks):
             if advance_seq:
                 self.seq_lens[row] += 1
+            self._check_token(req, tok)
             req.generated.append(tok)
             self._emit_token(req, tok)
             self.tokens[row] = tok
@@ -1053,6 +1068,80 @@ class ServingEngine:
         while self._inflight:
             self._reap_window(self._inflight.popleft())
 
+    def _check_token(self, req: _Request, tok: int) -> None:
+        """In-band output sanity guard, applied to every token id at the
+        moment it would COMMIT (the values are host ints the reap already
+        materialized — no new device pulls). An out-of-vocab id can only
+        come from corrupted state (weights, KV pages, a bad kernel —
+        ``sample_logits`` maps non-finite sampling-path logits to -1 for
+        exactly this reason), so the right move is to fail the engine
+        loudly: the loop's failure path turns that into redrivable
+        ``engine failure`` terminals instead of streaming garbage."""
+        if 0 <= tok < self.cfg.vocab_size:
+            return
+        self.stats["invalid_tokens"] = self.stats.get("invalid_tokens", 0) + 1
+        if self.invalid_token_counter is not None:
+            self.invalid_token_counter.inc()
+        from pretraining_llm_tpu.resilience.integrity import IntegrityError
+
+        err = IntegrityError(
+            f"invalid token id {tok} for rid {req.rid} (vocab size "
+            f"{self.cfg.vocab_size}): refusing to stream corrupted output"
+        )
+        # Structured fields for the loop's integrity_invalid_token event.
+        err.rid = req.rid
+        err.token = int(tok)
+        raise err
+
+    def _verify_shared(
+        self, req: _Request, cached_len: int, shared: List[int]
+    ) -> Tuple[int, List[int]]:
+        """Verify-on-acquire (``kv_checksum``): re-digest every shared
+        block against the checksum recorded when it was published. On the
+        first mismatch, keep only the verified prefix of the hit, release
+        the rest, and DROP the corrupt block from the cache — this
+        admission (and every future one) re-prefills those tokens
+        privately, so one flipped page costs exactly one hit's worth of
+        prefill instead of poisoning every request that shares it."""
+        from pretraining_llm_tpu.resilience import integrity
+
+        for j, b in enumerate(shared):
+            expected = self.prefix_cache.checksum_of(b)
+            if expected is None or (
+                integrity.kv_block_digest(self.pools, b) == expected
+            ):
+                continue
+            self.prefix_cache.release_shared(shared[j:])
+            self.prefix_cache.drop_block(b)
+            self.stats["kv_mismatches"] = (
+                self.stats.get("kv_mismatches", 0) + 1
+            )
+            if self.kv_mismatch_counter is not None:
+                self.kv_mismatch_counter.inc()
+            if self.decisions is not None:
+                tr = self.traces.get(req.rid)
+                self.decisions.record(
+                    "drop_corrupt_block",
+                    rid=req.rid,
+                    trace_id=getattr(tr, "trace_id", None),
+                    block=b,
+                    verified_blocks=j,
+                )
+                # The engine has no bus of its own; the loop's decision log
+                # carries the (replica-labelled) one.
+                if self.decisions.bus is not None:
+                    self.decisions.bus.emit(
+                        "integrity_kv_mismatch", rid=req.rid, block=b,
+                        verified_blocks=j,
+                    )
+            keep = shared[:j]
+            if len(keep) < self.prefix_cache.min_blocks:
+                if keep:
+                    self.prefix_cache.release_shared(keep)
+                return 0, []
+            return min(cached_len, len(keep) * self.block_size), keep
+        return cached_len, shared
+
     def _resolve_first(self, req: _Request) -> None:
         """Materialize a deferred admission token (device is done with it
         by the time any caller needs the value)."""
@@ -1061,6 +1150,7 @@ class ServingEngine:
         arr, i = req.pending_first
         req.pending_first = None
         tok = int(np.asarray(arr)[i])
+        self._check_token(req, tok)
         req.generated.append(tok)
         self._emit_token(req, tok)
         if req.row is not None:
@@ -1157,6 +1247,10 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 t_lookup = time.perf_counter()
                 cached_len, shared = self.prefix_cache.acquire(req.prompt)
+                if self.kv_checksum and shared:
+                    cached_len, shared = self._verify_shared(
+                        req, cached_len, shared
+                    )
                 t_hit = time.perf_counter()
             need_new = need - len(shared)
             # Admission watermark — where head-of-line admission stalls:
@@ -1699,10 +1793,21 @@ class ServingEngine:
                 publish_len = req.prefill_pos
             else:
                 publish_len = p + g - 1 if g else p
-            self.prefix_cache.release_row(
+            published = self.prefix_cache.release_row(
                 req.prompt + req.generated, req.blocks, req.n_shared,
                 publish_len,
             )
+            if self.kv_checksum and published:
+                # Record content digests AT publish — the pages below the
+                # committed frontier are final (shared pages are read-only
+                # and a row only ever writes ahead of it), so the digest
+                # taken here is the truth every later acquire verifies.
+                from pretraining_llm_tpu.resilience import integrity
+
+                for b in published:
+                    self.prefix_cache.set_checksum(
+                        b, integrity.kv_block_digest(self.pools, b)
+                    )
         else:
             self.alloc.free(req.blocks)
         req.blocks = []

@@ -59,6 +59,12 @@ DECISION_KINDS = (
     "eject_replica",      # router declared a replica dead/wedged and stopped routing to it
     "redrive",            # an in-flight request failed over to a surviving replica
     "brownout_shed",      # fleet degraded: low-priority work shed at the router
+
+    # Output-integrity sentinel (resilience/integrity.py): a quarantine
+    # costs every in-flight request on the replica a redrive, and a
+    # dropped cache block costs its next hit a private re-prefill.
+    "quarantine",          # sentinel pulled a divergent replica from service
+    "drop_corrupt_block",  # cached KV block failed verify-on-acquire; dropped
 )
 
 

@@ -75,6 +75,17 @@ EVENT_KINDS = (
     "brownout",           # fleet brownout entered/left: active, healthy, total
     "fleet_req_submit",   # router accepted a request: frid, replica, n_prompt
     "fleet_req_terminal", # router delivered a terminal: frid, status, redrives
+    # Output-integrity sentinel (resilience/integrity.py + router). Probe
+    # events carry the replica they exercised and whether the greedy
+    # output matched the pinned reference; mismatch events are the
+    # checksum detectors firing; quarantine is the sentinel's verdict
+    # (the matching ``quarantine`` decision carries the probe trace_id).
+    "fault_fired",               # armed corruption actually mutated engine state
+    "integrity_probe",           # probe completed: replica, ok, probe, n_tokens
+    "integrity_quarantine",      # replica pulled from service: replica, reason
+    "integrity_kv_mismatch",     # cached KV page failed verify-on-acquire: block
+    "integrity_weight_mismatch", # live weight fingerprint drifted: replica
+    "integrity_invalid_token",   # out-of-vocab token id reached reap: rid, token
 )
 
 

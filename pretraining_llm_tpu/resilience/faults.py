@@ -50,6 +50,12 @@ SERVING_FAULT_KINDS = (
     "replica_hang",   # next scheduler turn blocks (wedged-engine drill)
     "slow_window",    # next few turns run with an injected delay (SLO drill)
     "reject_storm",   # next few submissions to the replica are refused busy
+    # Silent-corruption kinds (integrity drills): the replica keeps
+    # answering — only its OUTPUTS are wrong — so crash/hang detection
+    # never fires and the output-integrity sentinel has to catch it.
+    "corrupt_kv_page",  # flip a published prefix-cache pool page in place
+    "corrupt_weights",  # negate the largest param leaf (bit-rot drill)
+    "wrong_token",      # force one out-of-vocab token id into the commit path
 )
 
 # How long an injected hang blocks the host loop. Effectively forever next to
@@ -263,6 +269,17 @@ class ServingFaultInjector:
         self._armed: Dict[int, List[str]] = {}   # replica -> crash/hang queue
         self._slow: Dict[int, int] = {}          # replica -> slowed ticks left
         self._storm: Dict[int, int] = {}         # replica -> rejects left
+        self._corrupt: Dict[int, List[str]] = {}  # replica -> corruption queue
+        self._engines: Dict[int, Any] = {}       # replica -> live engine handle
+
+    def attach_engine(self, replica: int, engine: Any) -> None:
+        """Give the injector the replica's LIVE engine (called from
+        Replica._launch_locked on every launch/relaunch): the corruption
+        kinds mutate engine state in place, which crash/hang never needed.
+        A relaunch re-attaches, so a quarantined replica's fresh engine is
+        the one any still-armed entries would hit."""
+        with self._lock:
+            self._engines[replica] = engine
 
     def on_submit(self, replica: int, nth_submit: int) -> None:
         """Called by a Replica after accepting its ``nth_submit``-th
@@ -283,6 +300,10 @@ class ServingFaultInjector:
                     )
                 if f.kind in ("replica_crash", "replica_hang"):
                     self._armed.setdefault(replica, []).append(f.kind)
+                elif f.kind in (
+                    "corrupt_kv_page", "corrupt_weights", "wrong_token"
+                ):
+                    self._corrupt.setdefault(replica, []).append(f.kind)
                 elif f.kind == "slow_window":
                     self._slow[replica] = (
                         self._slow.get(replica, 0) + self.slow_ticks
@@ -314,6 +335,19 @@ class ServingFaultInjector:
                 slow = self._slow.get(replica, 0)
                 if action is None and slow > 0:
                     self._slow[replica] = slow - 1
+                corrupt = self._corrupt.get(replica, [])
+                corruption = corrupt.pop(0) if corrupt else None
+                engine = self._engines.get(replica)
+            if corruption is not None:
+                # Fired on the loop thread (the engine's owner), BEFORE the
+                # turn, so the very next dispatched window runs against the
+                # corrupted state. A corruption with no target yet (e.g. a
+                # KV flip before anything is cached) stays armed.
+                if not self._fire_corruption(corruption, replica, engine):
+                    with self._lock:
+                        self._corrupt.setdefault(replica, []).insert(
+                            0, corruption
+                        )
             if action == "replica_crash":
                 raise InjectedFault(f"injected replica_crash on replica {replica}")
             if action == "replica_hang":
@@ -323,6 +357,97 @@ class ServingFaultInjector:
             return tick(*a, **kw)
 
         return _tick
+
+    # -- corruption actions (integrity drills) -------------------------
+
+    def _fire_corruption(
+        self, kind: str, replica: int, engine: Any
+    ) -> bool:
+        """Mutate the attached engine's state in place; returns False when
+        the fault has no target yet and should stay armed."""
+        if engine is None:
+            return False
+        fired = getattr(self, f"_fire_{kind}")(engine)
+        if fired and self.bus is not None:
+            self.bus.emit("fault_fired", fault=kind, replica=replica)
+        return fired
+
+    @staticmethod
+    def _fire_corrupt_kv_page(engine: Any) -> bool:
+        """Overwrite one PUBLISHED prefix-cache pool block with garbage —
+        the silent version of a DMA bit-flip on a shared page. Targets the
+        lowest cached block id so the drill is deterministic; with no
+        cache (or nothing published yet) it waits for one."""
+        import jax
+        import jax.numpy as jnp
+
+        cache = getattr(engine, "prefix_cache", None)
+        if cache is None:
+            return False
+        cached = cache.cached_block_ids()
+        if not cached:
+            return False
+        block = cached[0]
+
+        def _poison(leaf):
+            idx = (slice(None), block) if leaf.ndim >= 5 else (block,)
+            page = leaf[idx]
+            if jnp.issubdtype(page.dtype, jnp.floating):
+                bad = jnp.full_like(page, 100.0)
+            else:
+                bad = jnp.ones_like(page)
+            return leaf.at[idx].set(bad)
+
+        engine.pools = jax.tree_util.tree_map(_poison, engine.pools)
+        return True
+
+    @staticmethod
+    def _fire_corrupt_weights(engine: Any) -> bool:
+        """Negate the largest floating param leaf (the embedding table on
+        any realistic config): every forward pass afterwards is wrong, but
+        nothing crashes — exactly the failure mode golden probes and the
+        weight fingerprint exist to catch."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(engine.params)
+        target = None
+        for i, leaf in enumerate(leaves):
+            if not (
+                hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+            ):
+                continue
+            if target is None or leaf.size > leaves[target].size:
+                target = i
+        if target is None:
+            return False
+        leaves[target] = leaves[target] * -1
+        engine.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        return True
+
+    @staticmethod
+    def _fire_wrong_token(engine: Any) -> bool:
+        """Force the next committed token id out of vocab range by
+        shadowing ``engine._consume_tokens`` (one shot, then restored):
+        proves the reap-time sanity guard end-to-end — the guard must
+        raise before the garbage id reaches any client stream."""
+        import numpy as np
+
+        orig = engine._consume_tokens
+
+        def _bad(req, row, toks, advance_seq=True):
+            if len(toks) == 0:
+                return orig(req, row, toks, advance_seq)
+            engine._consume_tokens = orig
+            bad = np.array(
+                [engine.cfg.vocab_size + 7] + [int(t) for t in toks[1:]],
+                dtype=np.int64,
+            )
+            return orig(req, row, bad, advance_seq)
+
+        engine._consume_tokens = _bad
+        return True
 
 
 def truncate_leaf(ckpt_path: str, leaf: Optional[str] = None) -> Optional[str]:

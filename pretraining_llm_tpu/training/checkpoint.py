@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from pretraining_llm_tpu.observability.spans import span as _span
+from pretraining_llm_tpu.resilience import integrity
 
 
 def _leaf_name(path: Tuple[Any, ...]) -> str:
@@ -82,6 +83,13 @@ def _save_leaf(tmp: str, name: str, leaf: Any) -> Dict[str, Any]:
     entry["shape"] = list(arr.shape)
     entry["dtype"] = str(arr.dtype)
     entry["sharded"] = False
+    # Content checksum over the bytes actually written: restore verifies it
+    # so silent on-disk corruption (a flipped byte, a torn block that still
+    # parses) fails THIS step and falls back to an older one, instead of
+    # resuming training from poisoned weights. Sharded leaves skip it —
+    # their shard set differs per mesh and the assembled array is not a
+    # stable byte stream.
+    entry["checksum"] = integrity.array_digest(arr)
     return entry
 
 
@@ -289,7 +297,12 @@ def restore_latest_synced(
 def _load_leaf(path: str, entry: Dict[str, Any]) -> np.ndarray:
     name = entry["name"]
     if not entry.get("sharded"):
-        return np.load(os.path.join(path, f"{name}.npy"))
+        arr = np.load(os.path.join(path, f"{name}.npy"))
+        # Absent checksum = pre-checksum checkpoint: verify vacuously so
+        # old runs stay restorable. A mismatch raises IntegrityError, which
+        # restore_latest's fallback treats exactly like a torn write.
+        integrity.verify_array(arr, entry.get("checksum"), name)
+        return arr
     arr = np.empty(entry["shape"], dtype=np.dtype(entry["dtype"]))
     found = False
     for fname in os.listdir(path):

@@ -12,6 +12,7 @@ python -m compileall -q pretraining_llm_tpu scripts
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_observability.py \
+    tests/test_integrity.py \
     "tests/test_training.py::test_checkpoint_roundtrip_and_exact_resume" \
     "tests/test_training.py::test_checkpoint_retention" \
     "tests/test_training.py::test_checkpoint_sharded_leaf_reassembly" \
@@ -525,3 +526,117 @@ grep -q "lost=0" "$OBS_TMP/fleet_report.out" || {
     echo "obs_report --fleet did not report lost=0"; exit 1; }
 grep -q "redrive cost" "$OBS_TMP/fleet_report.out" || {
     echo "obs_report --fleet missing the redrive cost section"; exit 1; }
+
+# Integrity gate: a 2-replica fleet with golden probes on and a
+# corrupt_kv_page injected on replica 0 mid-burst — the flipped page is
+# the probes' own shared prefix block (kv_checksum stays OFF, so the ONLY
+# signal is wrong probe output). The sentinel must quarantine the replica,
+# zero client requests may be lost and every output must be served by a
+# healthy path, the merged /metrics must stay lint-clean with the typed
+# integrity counters, and the offline auditor must accept the event
+# stream with --integrity --strict (detection attributed, no orphan
+# divergence, no unanswered corruption).
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, time, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+
+def make_engine():
+    return ServingEngine(params, cfg, max_batch=2, n_blocks=24, block_size=8,
+                         temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                         prefix_cache=True)
+
+bus = EventBus(os.path.join(tmp, "integrity_events.jsonl"))
+faults = ServingFaultInjector("corrupt_kv_page@req1:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_")
+replicas = [
+    Replica(i, make_engine, bus=bus, fault_injector=faults)
+    for i in range(2)
+]
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=0.2, probe_interval_s=0.05,
+                probe_timeout_s=60.0).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+# Let probe #0 publish its shared prefix page on replica 0 — the fault
+# targets the lowest cached block id, i.e. exactly that page.
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    eng = router.replicas[0].engine
+    if eng is not None and eng.prefix_cache.cached_block_ids():
+        break
+    time.sleep(0.05)
+assert router.replicas[0].engine.prefix_cache.cached_block_ids(), \
+    "probe page never published"
+
+spec = LoadSpec(n_requests=12, mode="closed", concurrency=4, seed=9,
+                vocab_size=cfg.vocab_size, max_new_min=6, max_new_max=10)
+report = run_http(base, spec)
+
+lost = spec.n_requests - len(report.outcomes)
+assert lost == 0, f"{lost} requests lost"
+statuses = {}
+for o in report.outcomes:
+    statuses[o.status] = statuses.get(o.status, 0) + 1
+assert statuses == {"done": 12}, statuses
+
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if router.counters["quarantines"] >= 1:
+        break
+    time.sleep(0.05)
+assert router.counters["quarantines"] >= 1, router.counters
+quar = [d for d in router.decisions.tail()
+        if d["decision"] == "quarantine"]
+assert quar and quar[0]["replica"] == 0, quar
+
+# The quarantined replica relaunches with fresh weights and a clean pool.
+deadline = time.monotonic() + 10.0
+while time.monotonic() < deadline:
+    if all(rep.accepting for rep in router.replicas):
+        break
+    time.sleep(0.05)
+assert router.replicas[0].generation >= 2, router.replicas[0].debug_snapshot()
+
+with urllib.request.urlopen(f"{base}/debug/engine", timeout=30) as r:
+    dbg = json.loads(r.read())
+integ = dbg["fleet"]["integrity"]
+assert integ["enabled"] and integ["quarantines"] >= 1, integ
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_integrity_probes_total" in text, text[:400]
+assert "pllm_serving_quarantines_total" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"integrity smoke ok: {statuses}, "
+      f"probes={router.counters['probes']}, "
+      f"quarantines={router.counters['quarantines']}")
+EOF
+
+# The integrity auditor must accept the drill with --strict: the fired
+# corruption attributed to a detector, every strict probe divergence
+# answered by a quarantine, and no unanswered quarantine.
+python scripts/obs_report.py --integrity --strict \
+    "$OBS_TMP/integrity_events.jsonl" > "$OBS_TMP/integrity_report.out"
+grep -q "detected by" "$OBS_TMP/integrity_report.out" || {
+    echo "obs_report --integrity missing the detection attribution"; exit 1; }

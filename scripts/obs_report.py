@@ -872,6 +872,221 @@ def print_fleet_report(report: Dict[str, Any]) -> None:
         print(f"!! {p}")
 
 
+# -- integrity attribution (--integrity) ------------------------------------
+
+
+_CORRUPTION_KINDS = ("corrupt_kv_page", "corrupt_weights", "wrong_token")
+
+
+def build_integrity_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the integrity sentinel's event streams into the audit view:
+
+      detection    every corruption that actually FIRED (``fault_fired``)
+                   must be answered by a detector on that replica —
+                   a ``integrity_quarantine`` (probe/fingerprint verdict),
+                   an ``integrity_invalid_token`` (reap guard), or an
+                   ``integrity_kv_mismatch`` (verify-on-acquire) — and the
+                   fire-to-detection latency is the headline number;
+      exposure     tokens delivered (``req_done``) by the corrupted replica
+                   between fire and detection: an UPPER bound on wrong
+                   tokens served (requests not touching the corrupted
+                   state are counted too — the bound is what the operator
+                   can prove, not what the model emitted);
+      join         each ``quarantine`` decision should carry the failing
+                   probe's trace_id when tracing is on, so the verdict
+                   joins to a span tree (strict);
+      hygiene      a strict probe failure (completed, wrong tokens) must
+                   be followed by a quarantine; a quarantine must be
+                   preceded by a detector signal.
+    """
+    probes = [e for e in events if e.get("event") == "integrity_probe"]
+    quars = [e for e in events if e.get("event") == "integrity_quarantine"]
+    kv_mm = [e for e in events if e.get("event") == "integrity_kv_mismatch"]
+    w_mm = [
+        e for e in events if e.get("event") == "integrity_weight_mismatch"
+    ]
+    invalid = [
+        e for e in events if e.get("event") == "integrity_invalid_token"
+    ]
+    fired = [
+        e for e in events
+        if e.get("event") == "fault_fired"
+        and e.get("fault") in _CORRUPTION_KINDS
+    ]
+    armed = [
+        e for e in events
+        if e.get("event") == "fault_injected"
+        and e.get("fault") in _CORRUPTION_KINDS
+    ]
+    quar_decisions = [
+        e for e in events
+        if e.get("event") == "decision" and e.get("decision") == "quarantine"
+    ]
+    drop_decisions = [
+        e for e in events
+        if e.get("event") == "decision"
+        and e.get("decision") == "drop_corrupt_block"
+    ]
+
+    problems: List[str] = []
+
+    def _t(e: Dict[str, Any]) -> float:
+        return float(e.get("t_mono", 0.0))
+
+    # Detection: first detector record on the fired replica at or after
+    # the fire instant. Ejection for an IntegrityError surfaces as
+    # integrity_invalid_token (reap guard), so all three streams count.
+    detectors = sorted(quars + invalid + kv_mm + w_mm, key=_t)
+    detections: List[Dict[str, Any]] = []
+    for f in sorted(fired, key=_t):
+        rep = f.get("replica")
+        hit = next(
+            (
+                d for d in detectors
+                if _t(d) >= _t(f)
+                and (d.get("replica") is None or d.get("replica") == rep)
+            ),
+            None,
+        )
+        rec: Dict[str, Any] = {
+            "fault": f.get("fault"),
+            "replica": rep,
+            "detected": hit is not None,
+            "detector": hit.get("event") if hit is not None else None,
+            "detection_latency_s": (
+                _t(hit) - _t(f) if hit is not None else None
+            ),
+        }
+        # Exposure bound: completed requests the corrupted replica kept
+        # answering between fire and detection (end of log if undetected).
+        t_end = _t(hit) if hit is not None else float("inf")
+        rec["wrong_tokens_served_bound"] = sum(
+            int(e.get("n_tokens", 0)) for e in events
+            if e.get("event") == "req_done"
+            and e.get("replica") == rep
+            and _t(f) <= _t(e) <= t_end
+        )
+        detections.append(rec)
+        if hit is None:
+            problems.append(
+                f"UNDETECTED corruption: {f.get('fault')} fired on replica "
+                f"{rep} and no detector answered (quarantine/invalid_token/"
+                f"kv_mismatch/weight_mismatch)"
+            )
+
+    # Hygiene: a COMPLETED probe with wrong tokens is the sentinel's own
+    # verdict — a quarantine must follow (probes that error/expire/time
+    # out are the health loop's business and don't count here).
+    strict_failures = [
+        e for e in probes
+        if not e.get("ok") and str(e.get("status")) == "done"
+    ]
+    for e in strict_failures:
+        rep = e.get("replica")
+        if not any(
+            q.get("replica") == rep and _t(q) >= _t(e) for q in quars
+        ):
+            problems.append(
+                f"probe divergence on replica {rep} (t_mono={_t(e):.3f}) "
+                f"was never answered by a quarantine"
+            )
+    for q in quars:
+        rep = q.get("replica")
+        preceded = any(
+            e.get("replica") == rep and _t(e) <= _t(q)
+            for e in strict_failures + w_mm + invalid
+        )
+        if not preceded:
+            problems.append(
+                f"quarantine of replica {rep} (t_mono={_t(q):.3f}) has no "
+                f"preceding detector signal"
+            )
+    # Join: when any probe carried a trace, the quarantine decision must
+    # too — that's what lets the verdict join the span tree.
+    traced_probes = any(e.get("trace_id") for e in probes)
+    for d in quar_decisions:
+        if traced_probes and not d.get("trace_id"):
+            problems.append(
+                "quarantine decision lacks a trace_id while probes are "
+                "traced (decision-to-trace join broken)"
+            )
+
+    per_replica: Dict[str, Dict[str, int]] = {}
+
+    def _slot(r: Any) -> Dict[str, int]:
+        return per_replica.setdefault(
+            str(r), {"probes": 0, "probe_failures": 0, "quarantines": 0},
+        )
+
+    for e in probes:
+        slot = _slot(e.get("replica"))
+        slot["probes"] += 1
+        if not e.get("ok"):
+            slot["probe_failures"] += 1
+    for e in quars:
+        _slot(e.get("replica"))["quarantines"] += 1
+
+    latencies = sorted(
+        d["detection_latency_s"] for d in detections
+        if d["detection_latency_s"] is not None
+    )
+    return {
+        "probes_run": len(probes),
+        "probes_failed": sum(1 for e in probes if not e.get("ok")),
+        "quarantines": len(quars),
+        "kv_mismatches": len(kv_mm),
+        "weight_mismatches": len(w_mm),
+        "invalid_tokens": len(invalid),
+        "corruptions_armed": len(armed),
+        "corruptions_fired": len(fired),
+        "corrupt_blocks_dropped": len(drop_decisions),
+        "detections": detections,
+        "detection_latency_p50_s": _percentile(latencies, 0.50),
+        "detection_latency_max_s": latencies[-1] if latencies else None,
+        "per_replica": dict(sorted(per_replica.items())),
+        "problems": problems,
+    }
+
+
+def print_integrity_report(report: Dict[str, Any]) -> None:
+    print("== integrity ==")
+    print(
+        f"probes={report['probes_run']} "
+        f"failed={report['probes_failed']} "
+        f"quarantines={report['quarantines']} "
+        f"kv_mismatches={report['kv_mismatches']} "
+        f"invalid_tokens={report['invalid_tokens']}"
+    )
+    if report["corruptions_armed"] or report["corruptions_fired"]:
+        print(
+            f"corruptions: armed={report['corruptions_armed']} "
+            f"fired={report['corruptions_fired']} "
+            f"blocks_dropped={report['corrupt_blocks_dropped']}"
+        )
+    for d in report["detections"]:
+        if d["detected"]:
+            print(
+                f"  {d['fault']} on replica {d['replica']}: detected by "
+                f"{d['detector']} in {d['detection_latency_s']:.3f}s, "
+                f"wrong-tokens-served bound {d['wrong_tokens_served_bound']}"
+            )
+        else:
+            print(
+                f"  {d['fault']} on replica {d['replica']}: NOT DETECTED"
+            )
+    if report["per_replica"]:
+        print("== per-replica probes ==")
+        hdr = ("replica", "probes", "failed", "quarant")
+        print("  " + " ".join(f"{h:>8}" for h in hdr))
+        for rep, row in report["per_replica"].items():
+            print("  " + " ".join(f"{v:>8}" for v in (
+                rep, row["probes"], row["probe_failures"],
+                row["quarantines"],
+            )))
+    for p in report["problems"]:
+        print(f"!! {p}")
+
+
 def build_report(records: List[Dict[str, Any]], bins: int) -> Dict[str, Any]:
     events, metrics = split_records(records)
     counts: Dict[str, int] = {}
@@ -984,6 +1199,14 @@ def main() -> int:
         "per-replica waterfalls, redrive cost, replica recovery time; "
         "--strict makes a lost request or a dangling redrive fatal",
     )
+    parser.add_argument(
+        "--integrity", action="store_true",
+        help="integrity attribution from integrity_*/fault_fired events: "
+        "corruption-to-detection latency, probe/quarantine waterfall, "
+        "wrong-tokens-served exposure bound, decision-to-trace join; "
+        "--strict makes an undetected corruption, an unanswered probe "
+        "divergence, or a broken trace join fatal",
+    )
     args = parser.parse_args()
     if args.slo and not args.trace:
         parser.error("--slo needs --trace")
@@ -991,6 +1214,8 @@ def main() -> int:
         parser.error("--capacity needs events JSONL paths")
     if args.fleet and not args.paths:
         parser.error("--fleet needs events JSONL paths")
+    if args.integrity and not args.paths:
+        parser.error("--integrity needs events JSONL paths")
     if not args.paths and not args.trace:
         parser.error("nothing to analyze: pass JSONL paths and/or --trace")
 
@@ -1019,6 +1244,11 @@ def main() -> int:
         events, _ = split_records(records)
         fleet_report = build_fleet_report(events)
         report["fleet"] = fleet_report
+    integrity_report: Optional[Dict[str, Any]] = None
+    if args.integrity:
+        events, _ = split_records(records)
+        integrity_report = build_integrity_report(events)
+        report["integrity"] = integrity_report
     if args.json:
         print(json.dumps(report, indent=2, allow_nan=False))
     else:
@@ -1030,6 +1260,8 @@ def main() -> int:
             print_capacity_report(cap_report)
         if fleet_report is not None:
             print_fleet_report(fleet_report)
+        if integrity_report is not None:
+            print_integrity_report(integrity_report)
         if bad:
             print(f"!! {bad} unparseable line(s)", file=sys.stderr)
         if slo_report is not None and slo_report["dropped_spans"]:
@@ -1051,6 +1283,10 @@ def main() -> int:
         return 1
     if args.strict and fleet_report is not None and fleet_report["problems"]:
         for p in fleet_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
+        return 1
+    if args.strict and integrity_report is not None and integrity_report["problems"]:
+        for p in integrity_report["problems"]:
             print(f"STRICT: {p}", file=sys.stderr)
         return 1
     return 0

@@ -142,6 +142,27 @@ def main() -> None:
                         help="(--http) watchdog: eject a replica whose loop "
                         "has active requests but no completed scheduler turn "
                         "for this long; 0 = disabled (default: config)")
+    parser.add_argument("--kv_checksum", action="store_true",
+                        help="verify prefix-cache KV pages against digests "
+                        "recorded at publish; a corrupted shared page is "
+                        "dropped and the request re-prefills privately")
+    parser.add_argument("--probe_interval_s", type=float, default=None,
+                        help="(--http, replicas>1) golden-probe period: "
+                        "inject pinned greedy probes per replica and "
+                        "quarantine on output divergence; 0 = off "
+                        "(default: config)")
+    parser.add_argument("--probe_count", type=int, default=None,
+                        help="(--http) distinct golden probes to pin "
+                        "(default: config)")
+    parser.add_argument("--probe_max_new", type=int, default=None,
+                        help="(--http) tokens each probe decodes "
+                        "(default: config)")
+    parser.add_argument("--weight_fingerprint_interval_s", type=float,
+                        default=None,
+                        help="(--http) per-replica weight fingerprint "
+                        "recompute period; the sentinel quarantines on "
+                        "drift from the value pinned at launch; 0 = off "
+                        "(default: config)")
     args = parser.parse_args()
     if not args.http and not args.input_file:
         parser.error("--input_file is required unless --http is set")
@@ -191,6 +212,7 @@ def main() -> None:
             prefill_chunk_tokens=(
                 args.prefill_chunk_tokens or cfg.serving.prefill_chunk_tokens
             ),
+            kv_checksum=args.kv_checksum or cfg.serving.kv_checksum,
             **spec,
         )
 
@@ -303,6 +325,10 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
                 admission_factory=make_admission, fault_injector=faults,
                 loop_kwargs=dict(
                     idle_wait_s=fc.idle_wait_s, capacity_ring=fc.capacity_ring,
+                    weight_fingerprint_interval_s=pick(
+                        args.weight_fingerprint_interval_s,
+                        fc.weight_fingerprint_interval_s,
+                    ),
                 ),
             )
             for i in range(n_replicas)
@@ -320,6 +346,9 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             brownout_min_healthy_frac=fc.brownout_min_healthy_frac,
             brownout_min_priority=fc.brownout_min_priority,
             brownout_max_deadline_s=fc.brownout_max_deadline_s,
+            probe_interval_s=pick(args.probe_interval_s, fc.probe_interval_s),
+            probe_count=pick(args.probe_count, fc.probe_count),
+            probe_max_new=pick(args.probe_max_new, fc.probe_max_new),
         ).start()
     else:
         eng = make_engine()

@@ -111,6 +111,8 @@ def test_capacity_event_kinds_documented():
         "reclaim_spec", "expire_inflight", "defer_prefill_chunk",
         # fleet tier (frontend/router.py)
         "eject_replica", "redrive", "brownout_shed",
+        # integrity sentinel (resilience/integrity.py + router)
+        "quarantine", "drop_corrupt_block",
     }
 
 

@@ -1,0 +1,612 @@
+"""Output-integrity sentinel: golden probes, KV/weight checksums, and
+divergent-replica quarantine.
+
+The correctness bar: silent-wrong state (a flipped shared KV page, an
+in-place weight mutation, an out-of-vocab token from a corrupted
+sampling path) must be DETECTED and contained — the divergent replica
+quarantined, its in-flight work redriven bit-identically, a corrupted
+cache page re-prefilled privately — while with every knob off the
+detectors cost nothing on the decode hot path (no new device pulls,
+spy-enforced).
+
+Unit layer: probe/digest/fingerprint primitives. Integration layer:
+verify-on-acquire identity runs, checkpoint checksum fallback, and
+fleet drills where the ONLY signal is wrong output.
+"""
+
+import dataclasses
+import glob
+import importlib.util
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import FrontendConfig, get_preset
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.generate import generate
+from pretraining_llm_tpu.generation.paged import BlockAllocator
+from pretraining_llm_tpu.generation.prefix_cache import PrefixCache
+from pretraining_llm_tpu.generation.sampling import sample_logits
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.capacity import DECISION_KINDS
+from pretraining_llm_tpu.observability.events import EVENT_KINDS, EventBus
+from pretraining_llm_tpu.resilience import integrity
+from pretraining_llm_tpu.resilience.faults import (
+    ServingFault,
+    ServingFaultInjector,
+    parse_serving_faults,
+)
+from pretraining_llm_tpu.training import checkpoint as ckpt
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+BS = 8  # block_size used throughout
+
+# The offline analyzer doubles as the integrity-report checker: import it
+# as a module so tests assert with EXACTLY the logic the CI gate runs.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_integrity", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)])).tolist()
+        for i in range(n)
+    ]
+
+
+def _engine_factory(params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_blocks", 24)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("steps_per_sched", 4)
+    kw.setdefault("pipeline_depth", 2)
+
+    def factory():
+        return ServingEngine(params, CFG, temperature=0.0, **kw)
+
+    return factory
+
+
+def _undisturbed(params, prompts, n_new, **kw):
+    eng = _engine_factory(params, **kw)()
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return {rids[rid]: toks for rid, toks in out.items()}
+
+
+def _fleet(params, n=2, faults=None, bus=None, engine_kw=None,
+           loop_kwargs=None, **router_kw):
+    factory = _engine_factory(params, **(engine_kw or {}))
+    reps = [
+        Replica(i, factory, bus=bus, fault_injector=faults,
+                loop_kwargs=loop_kwargs)
+        for i in range(n)
+    ]
+    router_kw.setdefault("eject_backoff_s", 0.1)
+    return Router(reps, bus=bus, **router_kw)
+
+
+def _reference_greedy(params, prompt, n_new):
+    toks = generate(
+        params, CFG, jnp.asarray([prompt], jnp.int32), n_new,
+        jax.random.key(7), temperature=0.0,
+    )
+    return np.asarray(toks)[0].tolist()
+
+
+# -- vocabulary / knob validation -------------------------------------------
+
+
+def test_parse_corruption_faults():
+    plan = parse_serving_faults(
+        "corrupt_kv_page@req1:r0, corrupt_weights@req2, wrong_token@req1:r1"
+    )
+    assert plan == [
+        ServingFault("corrupt_kv_page", 1, 0),
+        ServingFault("corrupt_weights", 2, None),
+        ServingFault("wrong_token", 1, 1),
+    ]
+
+
+def test_integrity_vocabulary_registered():
+    for kind in ("quarantine", "drop_corrupt_block"):
+        assert kind in DECISION_KINDS
+    for kind in (
+        "fault_fired",
+        "integrity_probe",
+        "integrity_quarantine",
+        "integrity_kv_mismatch",
+        "integrity_weight_mismatch",
+        "integrity_invalid_token",
+    ):
+        assert kind in EVENT_KINDS
+
+
+def test_knob_validation(params):
+    reps = [Replica(0, _engine_factory(params))]
+    with pytest.raises(ValueError, match="probe_interval_s"):
+        Router(reps, probe_interval_s=-1.0)
+    with pytest.raises(ValueError, match="probe_count"):
+        Router(reps, probe_count=0)
+    with pytest.raises(ValueError, match="probe_max_new"):
+        Router(reps, probe_max_new=0)
+    with pytest.raises(ValueError, match="probe_timeout_s"):
+        Router(reps, probe_timeout_s=0.0)
+    with pytest.raises(ValueError, match="probe_interval_s"):
+        FrontendConfig(probe_interval_s=-0.5)
+    with pytest.raises(ValueError, match="probe_count"):
+        FrontendConfig(probe_count=0)
+    with pytest.raises(ValueError, match="weight_fingerprint_interval_s"):
+        FrontendConfig(weight_fingerprint_interval_s=-1.0)
+    eng = _engine_factory(params, n_blocks=8)()
+    with pytest.raises(ValueError, match="weight_fingerprint_interval_s"):
+        EngineLoop(eng, weight_fingerprint_interval_s=-1.0)
+
+
+def test_probes_refuse_sampling_engine(params):
+    # Bit-exact probe comparison is meaningless against stochastic decode:
+    # a sampling engine draws fresh noise per generation, so every probe
+    # would diverge and quarantine healthy replicas. The router must
+    # refuse at start, before pinning a baseline.
+    def sampling_factory():
+        return ServingEngine(params, CFG, temperature=0.8, max_batch=2,
+                             n_blocks=24, block_size=BS)
+
+    router = Router([Replica(0, sampling_factory)], probe_interval_s=0.2)
+    try:
+        with pytest.raises(ValueError, match="temperature"):
+            router.start()
+    finally:
+        router.stop()
+
+
+# -- probe primitives --------------------------------------------------------
+
+
+def test_probe_prompts_shared_prefix():
+    a = integrity.probe_prompts(4, 9, CFG.vocab_size)
+    b = integrity.probe_prompts(4, 9, CFG.vocab_size)
+    assert a == b  # deterministic for a fixed seed
+    for p in a:
+        assert len(p) == 9
+        assert all(0 <= t < CFG.vocab_size for t in p)
+        assert p[:-1] == a[0][:-1]  # shared prefix, last token differs
+    assert len({tuple(p) for p in a}) == len(a)
+    with pytest.raises(ValueError, match="n_probes"):
+        integrity.probe_prompts(0, 9, CFG.vocab_size)
+    with pytest.raises(ValueError, match="probe_len"):
+        integrity.probe_prompts(2, 1, CFG.vocab_size)
+
+
+def test_build_probe_set_pins_reference_greedy(params):
+    probes = integrity.build_probe_set(params, CFG, n_probes=2, probe_len=9,
+                                       max_new=4)
+    again = integrity.build_probe_set(params, CFG, n_probes=2, probe_len=9,
+                                      max_new=4)
+    assert probes == again
+    for p in probes:
+        assert list(p.expected) == _reference_greedy(params, list(p.prompt), 4)
+    # The pin must agree with the serving engine a healthy probe runs on:
+    # greedy bit-identity between the reference path and the engine is the
+    # invariant the whole sentinel rests on.
+    out = _undisturbed(params, [list(p.prompt) for p in probes], 4)
+    for i, p in enumerate(probes):
+        assert out[i] == list(p.expected)
+
+
+def test_weight_fingerprint_moves_on_corruption(params):
+    eng = _engine_factory(params, n_blocks=8)()
+    fp0 = integrity.weight_fingerprint(eng.params)
+    assert fp0 == integrity.weight_fingerprint(eng.params)  # deterministic
+    assert ServingFaultInjector._fire_corrupt_weights(eng)
+    assert integrity.weight_fingerprint(eng.params) != fp0
+
+
+def test_array_digest_and_verify():
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    d = integrity.array_digest(arr)
+    assert d == integrity.array_digest(arr.copy())
+    flipped = arr.copy()
+    flipped[3, 4] += 1
+    assert integrity.array_digest(flipped) != d
+    # dtype and shape are part of the digest, not just the bytes
+    assert integrity.array_digest(arr.reshape(4, 16)) != d
+    integrity.verify_array(arr, None, "w")  # pre-checksum ckpt: vacuous
+    integrity.verify_array(arr, d, "w")
+    with pytest.raises(integrity.IntegrityError, match="checksum mismatch"):
+        integrity.verify_array(flipped, d, "w")
+
+
+def test_kv_block_digest_detects_page_flip(params):
+    prompts = [p + [1, 2, 3] for p in [list(range(16))] * 2]
+    eng = _engine_factory(params, prefix_cache=True)()
+    for p in prompts:
+        eng.submit(p, 6)
+    eng.run()
+    cached = eng.prefix_cache.cached_block_ids()
+    assert cached
+    before = {b: integrity.kv_block_digest(eng.pools, b) for b in cached}
+    assert ServingFaultInjector._fire_corrupt_kv_page(eng)  # flips cached[0]
+    after = {b: integrity.kv_block_digest(eng.pools, b) for b in cached}
+    assert after[cached[0]] != before[cached[0]]
+    for b in cached[1:]:
+        assert after[b] == before[b]  # only the targeted page moved
+
+
+# -- verify-on-acquire (kv_checksum) ----------------------------------------
+
+
+def _shared_prefix_prompts(n, prefix_blocks=2, tail=(3, 5, 2, 6, 4, 1)):
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, CFG.vocab_size, size=prefix_blocks * BS).tolist()
+    out = []
+    for i in range(n):
+        t = int(tail[i % len(tail)])
+        out.append(prefix + rng.integers(0, CFG.vocab_size, size=t).tolist())
+    return out
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_verify_on_acquire_bit_identity(params, depth):
+    """Flip a published shared page between two bursts: the checksum
+    catches it at acquire, the block is dropped and re-prefilled
+    privately, and every output stays bit-identical to cache-off — the
+    corruption costs prefill work, never correctness."""
+    prompts = _shared_prefix_prompts(4)
+    n_new = 6
+    ref = _undisturbed(params, prompts * 2, n_new,
+                       pipeline_depth=depth, prefix_cache=False)
+
+    eng = _engine_factory(params, pipeline_depth=depth, prefix_cache=True,
+                          kv_checksum=True)()
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = {rids[r]: t for r, t in eng.run().items()}
+    assert eng.prefix_cache.cached_block_ids()
+    assert ServingFaultInjector._fire_corrupt_kv_page(eng)
+    rids2 = {eng.submit(p, n_new): len(prompts) + i
+             for i, p in enumerate(prompts)}
+    out.update({rids2[r]: t for r, t in eng.run().items() if r in rids2})
+
+    assert eng.stats.get("kv_mismatches", 0) >= 1
+    for i in range(len(prompts) * 2):
+        assert out[i] == ref[i], f"request {i} diverged past a corrupt page"
+    # Allocator conservation after drain: free list + cached = everything
+    # but reserved block 0 — the dropped block was freed, not leaked.
+    assert eng.alloc.available + eng.prefix_cache.cached_blocks == 24 - 1
+
+
+def test_drop_block_accounting():
+    """drop_block in every refcount state: cold -> freed now; shared ->
+    doomed, freed on final deref (never re-coldlisted); idempotent."""
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, BS)
+    hist = list(range(24))
+    need = -(-len(hist) // BS)
+    blocks = alloc.alloc(need)
+    cache.release_row(hist, blocks, 0, len(hist))
+    avail0 = alloc.available
+
+    # Drop the chain TAIL while cold: straight back to the allocator.
+    cold = cache.cached_block_ids()[-1]
+    cache.drop_block(cold)
+    assert alloc.available == avail0 + 1
+    assert cold not in cache.cached_block_ids()
+
+    cached, ids = cache.acquire(hist)
+    assert cached == 2 * BS and len(ids) == 2  # surviving prefix still hits
+    victim = ids[1]  # drop a block with a live reference
+    cache.drop_block(victim)  # unreachable now, freed on final deref
+    assert victim not in cache.cached_block_ids()
+    avail1 = alloc.available
+    cache.drop_block(victim)  # idempotent
+    assert alloc.available == avail1
+    cache.release_shared(ids)  # final deref frees ONLY the doomed block
+    assert alloc.available == avail1 + 1  # ids[0] re-coldlisted, not freed
+    # A fresh acquire can never map the dropped content again.
+    cached2, ids2 = cache.acquire(hist)
+    assert victim not in ids2 and cached2 == BS
+    cache.release_shared(ids2)
+
+
+# -- in-band token guard (satellite: reap sanity check) ----------------------
+
+
+def test_wrong_token_fails_engine_before_streaming(params):
+    """An out-of-vocab id at the commit point must raise — with NOTHING
+    streamed for it — rather than reach a client."""
+    eng = _engine_factory(params, n_blocks=8)()
+    streamed = []
+    eng.on_token = lambda rid, tok: streamed.append(tok)
+    eng.submit(_prompts(1)[0], 6)
+    assert ServingFaultInjector._fire_wrong_token(eng)
+    with pytest.raises(integrity.IntegrityError, match="invalid token"):
+        eng.run()
+    assert eng.stats.get("invalid_tokens", 0) == 1
+    assert all(0 <= t < CFG.vocab_size for t in streamed)
+
+
+def test_wrong_token_redrives_bit_identical(params):
+    """Fleet drill: the guard turns a corrupted commit into an engine
+    failure; the router redrives every in-flight request on that replica
+    and the final outputs are bit-identical to an undisturbed run (the
+    garbage token was never committed, so the frontier is clean)."""
+    prompts = _prompts(6)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new)
+    bus = EventBus()
+    events = []
+    bus.subscribe(events.append)
+    faults = ServingFaultInjector("wrong_token@req1:r0", bus=bus)
+    router = _fleet(params, faults=faults, bus=bus)
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], f"request {i} diverged after redrive"
+    assert router.counters["redrives"] >= 1
+    kinds = [e.get("event") for e in events]
+    assert "integrity_invalid_token" in kinds
+    assert "fault_fired" in kinds
+    inv = next(e for e in events if e.get("event") == "integrity_invalid_token")
+    assert inv["token"] >= CFG.vocab_size
+
+
+def test_token_guard_costs_no_syncs(params, monkeypatch):
+    """The guard runs on host ints the reap already materialized: device
+    pulls with the guard active must EQUAL pulls with it stubbed out."""
+    prompts = _prompts(4)
+
+    def run():
+        eng = _engine_factory(params, prefix_cache=True)()
+        rids = {eng.submit(p, 6): i for i, p in enumerate(prompts)}
+        real = np.asarray
+        pulls = [0]
+
+        def spy(a, *args, **kw):
+            if isinstance(a, jax.Array):
+                pulls[0] += 1
+            return real(a, *args, **kw)
+
+        monkeypatch.setattr(np, "asarray", spy)
+        try:
+            out = eng.run(pipeline=True)
+        finally:
+            monkeypatch.undo()
+        return {rids[r]: t for r, t in out.items()}, pulls[0]
+
+    out_guarded, pulls_guarded = run()
+    monkeypatch.setattr(ServingEngine, "_check_token",
+                        lambda self, req, tok: None)
+    out_stubbed, pulls_stubbed = run()
+    assert out_guarded == out_stubbed
+    assert pulls_guarded == pulls_stubbed
+
+
+def test_kv_digest_never_runs_with_checksum_off(params, monkeypatch):
+    """kv_checksum defaults off and must cost nothing: the digest (a
+    device pull per pool leaf) is never invoked."""
+    calls = [0]
+    real = integrity.kv_block_digest
+
+    def counting(pools, block):
+        calls[0] += 1
+        return real(pools, block)
+
+    monkeypatch.setattr(integrity, "kv_block_digest", counting)
+    prompts = _shared_prefix_prompts(3)
+    _undisturbed(params, prompts, 6, prefix_cache=True)
+    assert calls[0] == 0
+    _undisturbed(params, prompts, 6, prefix_cache=True, kv_checksum=True)
+    assert calls[0] > 0  # the knob is what gates it
+
+
+def test_sample_logits_nonfinite_guard():
+    """Non-finite sampling-path logits return -1 (out of vocab -> the
+    reap guard fails the request); finite rows are untouched and the
+    legitimate -inf introduced by top-k masking does not trigger."""
+    key = jax.random.key(0)
+    logits = np.zeros((3, 16), dtype=np.float32)
+    logits[0, 3] = 5.0
+    logits[1, 1] = np.nan
+    logits[2, 2] = np.inf
+    out = np.asarray(sample_logits(jnp.asarray(logits), key,
+                                   temperature=1.0, top_k=4))
+    assert 0 <= out[0] < 16
+    assert out[1] == -1 and out[2] == -1
+    # Greedy path: argmax of corrupt logits still lands in vocab; the
+    # golden probes own that case, the guard must not interfere.
+    g = np.asarray(sample_logits(jnp.asarray(logits), key, temperature=0.0))
+    assert g.shape == (3,)
+
+
+# -- checkpoint checksums ----------------------------------------------------
+
+
+def test_checkpoint_checksum_fallback(tmp_path):
+    """A bit-flipped leaf fails restore like a torn write: load raises
+    IntegrityError, restore_latest skips past it to the previous step."""
+    state1 = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+              "b": np.ones(8, dtype=np.float32)}
+    state2 = {"w": state1["w"] + 1.0, "b": state1["b"] * 2.0}
+    ckpt.save_checkpoint(str(tmp_path), 1, state1)
+    path2 = ckpt.save_checkpoint(str(tmp_path), 2, state2)
+
+    # Round-trip first: checksums verify on clean data.
+    restored, _ = ckpt.load_checkpoint(path2, state2)
+    np.testing.assert_array_equal(restored["w"], state2["w"])
+
+    # Flip one data byte in a step-2 leaf (file still parses as .npy).
+    leaf = sorted(glob.glob(os.path.join(path2, "*.npy")))[-1]
+    with open(leaf, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    with pytest.raises(integrity.IntegrityError, match="checksum mismatch"):
+        ckpt.load_checkpoint(path2, state2)
+
+    skipped = []
+    got = ckpt.restore_latest(str(tmp_path), state1,
+                              on_skip=lambda p, e: skipped.append((p, e)))
+    assert got is not None
+    state, _, step = got
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], state1["w"])
+    assert len(skipped) == 1
+    assert isinstance(skipped[0][1], integrity.IntegrityError)
+
+
+# -- fleet sentinel drills ---------------------------------------------------
+
+
+def _wait(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_probe_sentinel_quarantines_corrupt_kv_page(params):
+    """The full drill the CI gate runs: flip the probes' shared cached
+    page on one replica (kv_checksum OFF, so the only signal is wrong
+    output), and the sentinel must quarantine it from probe divergence
+    alone — zero client requests lost, all bit-identical, and the
+    offline integrity report attributes the detection."""
+    prompts = _prompts(6)
+    n_new = 8
+    kw = dict(prefix_cache=True)
+    ref = _undisturbed(params, prompts, n_new, **kw)
+
+    bus = EventBus()
+    events = []
+    bus.subscribe(events.append)
+    faults = ServingFaultInjector("corrupt_kv_page@req1:r0", bus=bus)
+    router = _fleet(params, faults=faults, bus=bus, engine_kw=kw,
+                    probe_interval_s=0.05, probe_timeout_s=60.0)
+    with router:
+        # Let probe #0 publish the shared prefix page on r0 — that page
+        # (the lowest cached id) is what the fault will flip.
+        _wait(
+            lambda: (router.replicas[0].engine is not None
+                     and router.replicas[0].engine.prefix_cache is not None
+                     and router.replicas[0].engine.prefix_cache.cached_block_ids()),
+            30.0, "probe prefix block published on r0",
+        )
+        # Client prompts are random (no overlap with the probe prefix), so
+        # the corruption is invisible to clients — only the sentinel sees it.
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        _wait(lambda: router.counters["quarantines"] >= 1, 30.0, "quarantine")
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i]
+    assert router.counters["probes"] >= 1
+    assert router.counters["probe_failures"] >= 1
+    quars = [d for d in router.decisions.tail() if d["decision"] == "quarantine"]
+    assert quars and quars[0]["replica"] == 0
+    assert "probe divergence" in quars[0]["reason"]
+    # The offline analyzer joins the fired fault to its detection.
+    report = obs_report.build_integrity_report(events)
+    assert report["problems"] == []
+    assert report["quarantines"] >= 1
+    assert report["corruptions_fired"] >= 1
+    det = report["detections"]
+    assert det and det[0]["fault"] == "corrupt_kv_page" and det[0]["detected"]
+    assert det[0]["detection_latency_s"] >= 0.0
+    # Probes ran on a fresh (relaunched) replica afterwards and passed:
+    # the integrity snapshot is exposed on readiness.
+    snap = router._integrity_snapshot()
+    assert snap["enabled"] and snap["quarantines"] >= 1
+
+
+def test_weight_fingerprint_sentinel_quarantines(params):
+    """In-place weight corruption: the loop-thread fingerprint drifts
+    from its pinned value and the sentinel quarantines without waiting
+    for a probe round-trip. Requests in flight on the corrupt replica
+    during the exposure window may stream wrong tokens (that bound is
+    exactly what obs_report measures) — but post-recovery traffic must
+    be bit-identical again."""
+    prompts = _prompts(4)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new)
+    bus = EventBus()
+    events = []
+    bus.subscribe(events.append)
+    faults = ServingFaultInjector("corrupt_weights@req1:r0", bus=bus)
+    router = _fleet(
+        params, faults=faults, bus=bus,
+        loop_kwargs=dict(weight_fingerprint_interval_s=0.05),
+        probe_interval_s=0.2, probe_timeout_s=60.0,
+    )
+    with router:
+        trigger = [router.submit(p, n_new) for p in prompts]
+        for r in trigger:
+            status, _, _ = r.result(timeout=120)
+            assert status == "done"  # exposure window: no identity claim
+        _wait(lambda: router.counters["quarantines"] >= 1, 30.0, "quarantine")
+        _wait(lambda: all(rep.accepting for rep in router.replicas), 30.0,
+              "relaunch")
+        # Post-recovery: fresh weights from the factory, identity restored.
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], f"post-recovery request {i} diverged"
+    assert any(
+        e.get("event") in ("integrity_weight_mismatch", "integrity_probe")
+        and (e.get("event") != "integrity_probe" or not e.get("ok", True))
+        for e in events
+    )
+    report = obs_report.build_integrity_report(events)
+    assert report["quarantines"] >= 1
+    assert report["corruptions_fired"] >= 1
+    assert report["detections"] and report["detections"][0]["detected"]
+
+
+def test_readyz_and_debug_surface_integrity(params):
+    router = _fleet(params, probe_interval_s=0.05, probe_timeout_s=60.0)
+    with router:
+        _wait(
+            lambda: all(
+                r["ok"]
+                for r in router._integrity_snapshot()["replicas"].values()
+            ),
+            30.0, "a passing probe on every replica",
+        )
+        ready = router.readiness()
+        dbg = router.debug_engine()
+    assert "integrity" in ready
+    per = ready["integrity"]["replicas"]
+    assert set(per) == {"0", "1"}
+    for snap in per.values():
+        assert snap["ok"] is True
+        assert snap["age_s"] >= 0.0
+    assert ready["integrity"]["quarantines"] == 0
+    assert dbg["fleet"]["integrity"]["probes_run"] >= 2
+    # Disabled by default: no integrity section, no probe threads.
+    router2 = _fleet(params)
+    with router2:
+        assert "integrity" not in router2.readiness()
+        assert router2.counters["probes"] == 0
