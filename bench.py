@@ -188,6 +188,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "Pallas block-table kernel, gather = XLA pool[tables] assembly)",
     )
     parser.add_argument(
+        "--quantize", default="", choices=["", "none", "int8", "int8-kv"],
+        help="serving/serving-slo mode: int8 serving quantization. 'int8' "
+        "= per-channel int8 weights (attention/FFN projections, bf16 "
+        "accumulation); 'int8-kv' additionally packs the KV pool as int8 "
+        "pages with bf16 per-token scales (~1.9x block capacity at "
+        "head_dim 64 for the same HBM budget). Records gain a "
+        "'quantization' block with model-bytes and KV-bytes-per-token",
+    )
+    parser.add_argument(
         "--spec-draft", default="", choices=["", "self"],
         help="serving mode: speculative decoding draft. 'self' uses the "
         "TARGET as its own draft — acceptance ~100%%, measuring the "
@@ -345,6 +354,7 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         "--prefix-pool-size": args.prefix_pool_size,
         "--prefix-len": args.prefix_len,
         "--prefill-chunk-tokens": args.prefill_chunk_tokens,
+        "--quantize": args.quantize,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -421,6 +431,30 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         rec["metric"] += "_unstacked"  # distinct series vs the stacked layout
         rec["decode_cache_layout"] = "unstacked"
     return rec
+
+
+_QUANT_SUFFIX = {"int8": "_q8", "int8-kv": "_q8kv"}
+
+
+def _quantization_block(eng, raw_params) -> dict:
+    """Model-bytes / KV-bytes-per-token estimate block for serving records:
+    the capacity-planning numbers a quantize before/after comparison needs
+    next to its tok/s and TPOT. ``raw_params`` is the pre-quantize tree so
+    the bf16 model footprint rides the same record."""
+    from pretraining_llm_tpu.models import quantize as quantize_mod
+
+    info = eng.pool_info()
+    bsz = info["block_size"]
+    return {
+        "quantize": info["quantize"],
+        "kv_dtype": info["kv_dtype"],
+        "kv_scale_dtype": info["kv_scale_dtype"],
+        "model_bytes": quantize_mod.param_bytes(eng.params),
+        "model_bytes_unquantized": quantize_mod.param_bytes(raw_params),
+        "kv_pool_bytes": info["pool_bytes"],
+        "kv_bytes_per_block": info["bytes_per_block"],
+        "kv_bytes_per_token": round(info["bytes_per_block"] / bsz, 1),
+    }
 
 
 def run_serving_bench(args: argparse.Namespace) -> dict:
@@ -502,15 +536,16 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
             steps_per_sched=sps, pipeline_depth=depth,
             admit_batch=args.admit_batch,
             prefix_cache=args.prefix_cache,
-            prefill_chunk_tokens=args.prefill_chunk_tokens, **spec,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            quantize=args.quantize or "none", **spec,
         )
         rids = [eng.submit(p, new_tokens) for p in prompts]
         out = eng.run(pipeline=not args.no_pipeline)
-        return sum(len(out[r]) for r in rids), eng.stats
+        return sum(len(out[r]) for r in rids), eng.stats, eng
 
     serve()  # compile + warm (prefill buckets + the window program)
     t0 = time.perf_counter()
-    n_tok, stats = serve()
+    n_tok, stats, eng = serve()
     dt = time.perf_counter() - t0
     # The fraction of the serving wall the host spent BLOCKED on a
     # window readback — the quantity the in-flight queue exists to
@@ -538,9 +573,12 @@ def run_serving_bench(args: argparse.Namespace) -> dict:
         "n_blocks": n_blocks,
         "kv_cache_dtype": cfg.kv_cache_dtype,
         "engine_stats": stats,
+        "quantization": _quantization_block(eng, params),
         "wall_s": round(dt, 2),
         "device": jax.devices()[0].device_kind,
     }
+    if args.quantize in _QUANT_SUFFIX:
+        rec["metric"] += _QUANT_SUFFIX[args.quantize]  # distinct series
     if spec:
         rec["metric"] += "_spec"  # self-draft upper-bound series
         rec["spec_k"] = args.spec_k
@@ -663,6 +701,7 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
             admit_batch=args.admit_batch,
             prefix_cache=args.prefix_cache,
             prefill_chunk_tokens=chunk_tokens,
+            quantize=args.quantize or "none",
         )
         admission = AdmissionController(max_queue_depth=4 * max_batch)
         loop = EngineLoop(eng, admission=admission)
@@ -708,8 +747,11 @@ def run_serving_slo_bench(args: argparse.Namespace) -> dict:
         "block_size": block_size,
         "n_blocks": n_blocks,
         "wall_s": round(report.wall_s, 2),
+        "quantization": _quantization_block(eng, params),
         "device": jax.devices()[0].device_kind,
     }
+    if args.quantize in _QUANT_SUFFIX:
+        rec["metric"] += _QUANT_SUFFIX[args.quantize]  # distinct series
     if args.context:
         rec["metric"] += f"_ctx{args.context}"  # distinct series per context
     if pfx_pool:
@@ -814,6 +856,7 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         # Per-replica engine knobs not yet plumbed through the fleet
         # launcher; rejected rather than silently ignored.
         "--prefill-chunk-tokens": args.prefill_chunk_tokens,
+        "--quantize": args.quantize,
     }
     bad = [k for k, v in noop.items() if v]
     if bad:
@@ -985,7 +1028,8 @@ def run_trainer_bench(args: argparse.Namespace) -> dict:
             "--prefix-cache": args.prefix_cache,
             "--prefix-pool-size": args.prefix_pool_size,
             "--prefix-len": args.prefix_len,
-            "--prefill-chunk-tokens": args.prefill_chunk_tokens}
+            "--prefill-chunk-tokens": args.prefill_chunk_tokens,
+            "--quantize": args.quantize}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the trainer path")
@@ -1108,7 +1152,8 @@ def run_bench(args: argparse.Namespace) -> dict:
             "--prefix-cache": args.prefix_cache,
             "--prefix-pool-size": args.prefix_pool_size,
             "--prefix-len": args.prefix_len,
-            "--prefill-chunk-tokens": args.prefill_chunk_tokens}
+            "--prefill-chunk-tokens": args.prefill_chunk_tokens,
+            "--quantize": args.quantize}
     bad = [k for k, v in noop.items() if v]
     if bad:
         raise ValueError(f"{', '.join(bad)} have no effect on the train path")
@@ -1471,6 +1516,8 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd.append("--prefix-cache")
     if args.prefill_chunk_tokens:
         cmd += ["--prefill-chunk-tokens", str(args.prefill_chunk_tokens)]
+    if args.quantize:
+        cmd += ["--quantize", args.quantize]
     if args.mode == "serving-slo":
         cmd += [
             "--rate-rps", str(args.rate_rps),

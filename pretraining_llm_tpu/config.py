@@ -186,7 +186,9 @@ class ModelConfig:
     # runs the Pallas block-table kernel (ops/pallas_paged.py) that reads
     # pool pages directly — no gathered copy is ever written, cutting the
     # per-layer decode KV traffic ~3x at large batch*context. int8 pools
-    # require "gather" (scale pages dequantize inside the gather).
+    # compose with both: "gather" dequantizes after the pool gather,
+    # "kernel" fuses the scale-page dequant into the ragged kernel's page
+    # loop (only int8 bytes + scales cross HBM).
     paged_attention_impl: str = "gather"  # gather | kernel
 
     def __post_init__(self) -> None:
@@ -200,11 +202,10 @@ class ModelConfig:
                 f"paged_attention_impl must be 'gather' or 'kernel', got "
                 f"{self.paged_attention_impl!r}"
             )
-        if self.paged_attention_impl == "kernel" and self.kv_cache_dtype == "int8":
-            raise ValueError(
-                "paged_attention_impl='kernel' does not support int8 pools; "
-                "use 'gather' (it fuses the scale-page dequantize)"
-            )
+        # int8 pools work with BOTH paged impls: "gather" dequantizes
+        # after the pool gather, "kernel" routes every query shape through
+        # the ragged kernel, which fuses the scale-page dequant into its
+        # page loop (ops/pallas_ragged.py).
         if self.activation not in _ACTIVATIONS:
             raise ValueError(f"activation must be one of {_ACTIVATIONS}, got {self.activation!r}")
         if self.norm not in _NORMS:
@@ -774,8 +775,27 @@ class ServingConfig:
     # publish/acquire boundaries, never per decode window. Off by default
     # (the zero-device-sync path).
     kv_checksum: bool = False
+    # Quantized serving mode (models/quantize.py + the int8 KV pool):
+    #   "none"    — bf16 weights, pool dtype per model.kv_cache_dtype.
+    #   "int8"    — per-channel int8 block projections (attention + FFN;
+    #               embeddings/lm_head/norms/biases stay bf16), dequantized
+    #               at each use site with fp32 scales and bf16 accumulation.
+    #   "int8-kv" — "int8" PLUS the int8 KV pool with bf16 scale pages:
+    #               per-slot bytes drop from 2*Dh to Dh+2, so the pool
+    #               holds ~1.94x (Dh=64) the blocks of a bf16 pool at the
+    #               same HBM budget. Greedy outputs are deterministic
+    #               run-to-run WITHIN the quantized graph (the integrity
+    #               sentinel re-pins its golden probes there), but differ
+    #               from the bf16 graph — don't mix quantized and exact
+    #               replicas behind one sentinel.
+    quantize: str = "none"  # none | int8 | int8-kv
 
     def __post_init__(self) -> None:
+        if self.quantize not in ("none", "int8", "int8-kv"):
+            raise ValueError(
+                "serving.quantize must be 'none', 'int8' or 'int8-kv', "
+                f"got {self.quantize!r}"
+            )
         if self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {self.pipeline_depth}"

@@ -193,6 +193,24 @@ class EngineLoop:
             engine.kv_mismatch_counter = registry.counter(
                 "kv_checksum_mismatch_total",
                 "cached KV pages that failed verify-on-acquire")
+            # KV-pool residency is static per engine (pools are allocated
+            # once at construction), so the gauge is set here rather than
+            # on the per-window path. Includes scale pools on quantized
+            # engines — it is the number capacity planning compares across
+            # quantize modes at a fixed HBM budget.
+            pool_info = getattr(engine, "pool_info", None)
+            if pool_info is not None:
+                info = pool_info()
+                registry.gauge(
+                    "kv_pool_bytes",
+                    "resident KV pool bytes across layers, including "
+                    "quantization scale pools",
+                ).set(info["pool_bytes"])
+                registry.gauge(
+                    "kv_pool_bytes_per_block",
+                    "KV pool bytes per block across layers (quantized "
+                    "pools pack more tokens per byte)",
+                ).set(info["bytes_per_block"])
         else:
             self._c_shed = {}
         # Capacity observability (observability/capacity.py): occupancy
@@ -213,6 +231,10 @@ class EngineLoop:
                 bus=bus,
                 admission_snapshot_fn=(
                     admission.snapshot if admission is not None else None
+                ),
+                pool_layout=(
+                    engine.pool_info()
+                    if hasattr(engine, "pool_info") else None
                 ),
             )
             self.decisions = DecisionLog(maxlen=capacity_ring, bus=bus)
@@ -608,6 +630,13 @@ class EngineLoop:
                 "cold": cold,
                 "live": pool_total - free - cold,
             },
+            # Pool byte/dtype identity (quantize mode, KV dtype, scale
+            # dtype, bytes-per-block): how an operator confirms which
+            # graph a replica is actually serving from /debug/engine.
+            **(
+                {"pool_layout": eng.pool_info()}
+                if hasattr(eng, "pool_info") else {}
+            ),
             "stats": {
                 k: v for k, v in list(eng.stats.items())
                 if isinstance(v, (int, float))

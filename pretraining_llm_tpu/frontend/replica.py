@@ -99,6 +99,7 @@ class Replica:
         bus: Any = None,
         tracer: Any = None,
         registry_prefix: str = "pllm_serving_",
+        registry_labels: Optional[Dict[str, Any]] = None,
         admission_factory: Optional[Callable[[Any], AdmissionController]] = None,
         fault_injector: Any = None,
         clock: Any = time.monotonic,
@@ -114,8 +115,12 @@ class Replica:
         self._loop_kwargs = dict(loop_kwargs or {})
         # One registry per replica, same names fleet-wide, distinguished by
         # the constant label; survives relaunches so counters stay totals.
+        # ``registry_labels`` carries fleet-wide constant labels (e.g. the
+        # quant_dtype the whole fleet serves at); the replica index wins
+        # any collision because it is what tells the series apart.
         self.registry = MetricsRegistry(
-            registry_prefix, const_labels={"replica": self.index}
+            registry_prefix,
+            const_labels={**(registry_labels or {}), "replica": self.index},
         )
         self.state = "ejected"  # not launched yet; start() flips to active
         self.generation = 0     # bumped per (re)launch

@@ -147,6 +147,7 @@ class ServingEngine:
         prefix_cache: bool = False,
         prefix_cache_min_blocks: int = 1,
         kv_checksum: bool = False,
+        quantize: str = "none",
         mesh: Any = None,
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
@@ -192,6 +193,42 @@ class ServingEngine:
             if draft_cfg.doc_mask_token >= 0:
                 draft_cfg = dataclasses.replace(draft_cfg, doc_mask_token=-1)
             self.draft_cfg = draft_cfg
+        # Quantized serving (models/quantize.py): "int8" quantizes the
+        # block projections (per-channel symmetric, dequantized at each
+        # use site); "int8-kv" ALSO flips the KV pool to int8 codes with
+        # bf16 scale pages — per-slot bytes Dh+2 vs 2*Dh, ~1.94x the
+        # blocks of a bf16 pool at equal HBM (Dh=64). Greedy outputs are
+        # deterministic run-to-run within the quantized graph but differ
+        # from bf16 serving; the sentinel pins probes per-graph.
+        if quantize not in ("none", "int8", "int8-kv"):
+            raise ValueError(
+                f"quantize must be 'none', 'int8' or 'int8-kv', got "
+                f"{quantize!r}"
+            )
+        self.quantize = quantize
+        if quantize != "none":
+            from pretraining_llm_tpu.models import quantize as quantize_mod
+
+            if quantize == "int8-kv" and cfg.kv_cache_dtype != "int8":
+                # int8-kv implies the int8 pool — flip the model knob here
+                # so callers set ONE serving-level switch.
+                cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+                if (
+                    self.draft_cfg is not None
+                    and self.draft_cfg.kv_cache_dtype != "int8"
+                ):
+                    self.draft_cfg = dataclasses.replace(
+                        self.draft_cfg, kv_cache_dtype="int8"
+                    )
+            # Pre-quantized params (serve.py quantizes BEFORE sharding so
+            # scale leaves ride shard_params_for_inference) pass through;
+            # raw bf16/fp32 trees are quantized here for direct callers.
+            if not quantize_mod.is_quantized(params):
+                params = quantize_mod.quantize_params_for_serving(params, cfg)
+            if self.spec_k and not quantize_mod.is_quantized(self.draft_params):
+                self.draft_params = quantize_mod.quantize_params_for_serving(
+                    self.draft_params, self.draft_cfg
+                )
         self.params = params
         self.cfg = cfg
         self.max_batch = int(max_batch)
@@ -268,7 +305,12 @@ class ServingEngine:
 
         def _build_pool(pool_cfg: ModelConfig):
             pools = transformer.make_paged_kv_pool(
-                pool_cfg, n_blocks, block_size
+                pool_cfg, n_blocks, block_size,
+                # bf16 scale pages are what carry int8-kv past the 1.9x
+                # block-capacity target; legacy int8 pools (kv_cache_dtype
+                # set directly, quantize='none') keep fp32 scales for
+                # bit-compatibility with the dense int8 cache.
+                scale_dtype="bfloat16" if self.quantize == "int8-kv" else None,
             )
             if mesh is None:
                 return pools
@@ -309,6 +351,7 @@ class ServingEngine:
         # draft-model dims per block (paged_spec_round's shared-frontier
         # contract).
         self.d_pools = _build_pool(self.draft_cfg) if self.spec_k else None
+        self.n_blocks = int(n_blocks)
         self.alloc = paged.BlockAllocator(n_blocks)
         self.tables = np.zeros((self.max_batch, self.max_blocks), np.int32)
         self.seq_lens = np.zeros((self.max_batch,), np.int32)
@@ -408,6 +451,35 @@ class ServingEngine:
             )
 
     # -- public API --------------------------------------------------------
+
+    def pool_info(self) -> Dict[str, Any]:
+        """KV-pool layout facts for /debug/engine, the capacity snapshot
+        and the `pllm_kv_pool_bytes` gauge: element dtypes, bytes per
+        block and total pool bytes — summed over ALL pool leaves (scale
+        pages included), host-side shape math only (no device sync).
+        Draft pools (speculative serving) are reported separately."""
+        pools = self.pools
+        layer0 = pools["layers"][0] if "layers" in pools else pools
+        total = int(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(pools))
+        )
+        info = {
+            "quantize": self.quantize,
+            "kv_dtype": str(layer0["k_pool"].dtype),
+            "kv_scale_dtype": (
+                str(layer0["k_scale_pool"].dtype)
+                if "k_scale_pool" in layer0 else None
+            ),
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "bytes_per_block": total // self.n_blocks,
+            "pool_bytes": total,
+        }
+        if self.d_pools is not None:
+            info["draft_pool_bytes"] = int(
+                sum(leaf.nbytes for leaf in jax.tree.leaves(self.d_pools))
+            )
+        return info
 
     def validate_request(
         self, prompt_ids: Sequence[int], max_new_tokens: Any
@@ -1351,6 +1423,17 @@ class ServingEngine:
         # the full prefill — one batched program per non-empty group.
         miss = [r for r in admits if r.n_shared == 0]
         hits = [r for r in admits if r.n_shared > 0]
+        if miss and self.quantize == "int8-kv":
+            # Quantized-pool bit-identity: the monolithic lane's dense
+            # flash-prefill shortcut attends the UNQUANTIZED local k/v,
+            # while the suffix lane attends dequantized pool pages — the
+            # two would commit DIFFERENT quantized bytes for the same
+            # prompt, breaking identity across prefix-cache/chunked
+            # configurations. Route every admission through the suffix
+            # lane (cached_len 0 = full prompt) so page bytes are always
+            # the same pure function of the token's prompt prefix.
+            hits = miss + hits
+            miss = []
         t_prefill = time.perf_counter()
         groups: List[Tuple[List[_Request], jax.Array]] = []
         if miss:

@@ -173,6 +173,18 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
+def _weight(sub: Params, name: str, cdt: Any) -> jax.Array:
+    """Matmul weight read in compute dtype — the single dequant point for
+    int8 serving params (models/quantize.py). A quantized projection is an
+    int8 leaf plus a sibling ``{name}_scale`` fp32 leaf (per-output-channel
+    symmetric); dequant is one fp32 multiply, then the SAME compute-dtype
+    cast the bf16 path takes, so the matmul accumulates identically."""
+    w = sub[name]
+    if w.dtype == jnp.int8:
+        return (w.astype(jnp.float32) * sub[name + "_scale"]).astype(cdt)
+    return w.astype(cdt)
+
+
 def _attention_block(
     blk: Params,
     x: jax.Array,
@@ -211,7 +223,7 @@ def _attention_block(
     if "wqkv" in blk["attn"]:
         qkv = jnp.einsum(
             "btd,dchn->bchtn" if hm else "btd,dchn->bcthn",
-            h.astype(cdt), blk["attn"]["wqkv"].astype(cdt),
+            h.astype(cdt), _weight(blk["attn"], "wqkv", cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         if "bqkv" in blk["attn"]:
@@ -224,12 +236,12 @@ def _attention_block(
         # GQA: H query heads, kv_heads <= H key/value heads.
         q = jnp.einsum(
             "btd,dhn->bhtn" if hm else "btd,dhn->bthn",
-            h.astype(cdt), blk["attn"]["wq"].astype(cdt),
+            h.astype(cdt), _weight(blk["attn"], "wq", cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         kvp = jnp.einsum(
             "btd,dcgn->bcgtn" if hm else "btd,dcgn->bctgn",
-            h.astype(cdt), blk["attn"]["wkv"].astype(cdt),
+            h.astype(cdt), _weight(blk["attn"], "wkv", cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt)
         if "bq" in blk["attn"]:
@@ -339,14 +351,39 @@ def _attention_block(
                 "v_pool": scatter(kv["v_pool"], v),
             }
 
-        if cfg.paged_attention_impl == "kernel" and not quantized:
+        if cfg.paged_attention_impl == "kernel" and quantized:
+            # int8 pools through the kernel path: the ragged kernel fuses
+            # the per-(slot, head) dequant into its page loop — int8 bytes
+            # + scale pages are what crosses HBM, never a dequantized
+            # (B, kv_len) copy. EVERY query shape routes the ragged form
+            # (decode steps pass q_lens=1 per row, uniform multi-token
+            # verifies pass q_lens=tq): one kernel owns quantized decode,
+            # chunked prefill AND the speculative verify, so the quantized
+            # graph has a single attention numerics path.
+            from pretraining_llm_tpu.ops.pallas_ragged import (
+                ragged_paged_attention,
+            )
+
+            if tq > 1 and paged.q_lens is not None:
+                q_lens = paged.q_lens
+            else:
+                q_lens = jnp.full((bsz,), tq, dtype=seq.dtype)
+            out = ragged_paged_attention(
+                q.astype(cdt),
+                new_kv["k_pool"],
+                new_kv["v_pool"],
+                tables, seq, q_lens,
+                window=cfg.sliding_window,
+                k_scale=new_kv["k_scale_pool"],
+                v_scale=new_kv["v_scale_pool"],
+            )
+        elif cfg.paged_attention_impl == "kernel":
             # Gather-free: the Pallas kernel DMAs each row's pages straight
             # off the pool via the block table (ops/pallas_paged.py) — the
             # row's KV bytes are read once, no (B, kv_len) copy is ever
             # materialized. tq > 1 routes the multi-token form (the
             # speculative verify's per-query frontiers live inside the
-            # kernel mask). (int8 pools keep the gather below: validation
-            # rejects the combination at config time.)
+            # kernel mask).
             if tq > 1 and paged.q_lens is not None:
                 # Ragged multi-token form (chunked prefill): rows carry
                 # heterogeneous true query counts; the ragged kernel
@@ -563,7 +600,7 @@ def _attention_block(
     if cfg.use_output_proj:
         out = jnp.einsum(
             "bhtn,hnd->btd" if hm else "bthn,hnd->btd",
-            out, blk["attn"]["wo"].astype(cdt),
+            out, _weight(blk["attn"], "wo", cdt),
             preferred_element_type=jnp.float32,
         ).astype(cdt) + blk["attn"]["bo"].astype(cdt)
     else:
@@ -587,21 +624,21 @@ def _mlp_block(
         return x + out.astype(x.dtype), aux
     if cfg.activation == "swiglu":
         gates = jnp.einsum(
-            "btd,dcf->bctf", h, mlp["w1"].astype(cdt), preferred_element_type=jnp.float32
+            "btd,dcf->bctf", h, _weight(mlp, "w1", cdt), preferred_element_type=jnp.float32
         ).astype(cdt)
         if "b1" in mlp:
             gates = gates + mlp["b1"].astype(cdt)[None, :, None, :]
         hidden = jax.nn.silu(gates[:, 0]) * gates[:, 1]
     else:
         hidden = jnp.einsum(
-            "btd,df->btf", h, mlp["w1"].astype(cdt), preferred_element_type=jnp.float32
+            "btd,df->btf", h, _weight(mlp, "w1", cdt), preferred_element_type=jnp.float32
         ).astype(cdt)
         if "b1" in mlp:
             hidden = hidden + mlp["b1"].astype(cdt)
         hidden = layers.activation_fn(cfg.activation, hidden)
     hidden = checkpoint_name(hidden, "mlp_hidden")
     out = jnp.einsum(
-        "btf,fd->btd", hidden, mlp["w2"].astype(cdt), preferred_element_type=jnp.float32
+        "btf,fd->btd", hidden, _weight(mlp, "w2", cdt), preferred_element_type=jnp.float32
     ).astype(cdt)
     if "b2" in mlp:
         out = out + mlp["b2"].astype(cdt)
@@ -1363,16 +1400,27 @@ def make_kv_cache(
 
 
 def make_paged_kv_pool(
-    cfg: ModelConfig, n_blocks: int, block_size: int, dtype: Any = None
+    cfg: ModelConfig, n_blocks: int, block_size: int, dtype: Any = None,
+    *, scale_dtype: Any = None,
 ) -> KVCache:
     """Block POOL layout for paged serving decode (see PagedInfo).
 
     Pools are stacked over layers like the contiguous cache and ride the
     same depth-scan carry: {'k_pool','v_pool'}: (L, n_blocks, block_size,
-    kv_heads, Dh), plus fp32 scale pools when ``kv_cache_dtype='int8'``.
+    kv_heads, Dh), plus scale pools when ``kv_cache_dtype='int8'``.
     Block 0 is reserved by convention as the idle-row scratch target (the
     serving engine parks inactive batch rows on it); allocators hand out
     ids from 1.
+
+    ``scale_dtype`` (int8 pools only) picks the per-(slot, head) scale
+    element type: fp32 by default (historical layout, bit-compatible with
+    the dense int8 cache), bfloat16 for the ``serving.quantize=int8-kv``
+    mode — per-slot bytes drop from Dh+4 to Dh+2, so an int8-kv pool
+    holds 2*Dh/(Dh+2) ≈ 1.94x (Dh=64) the blocks of a bf16 pool at equal
+    HBM budget (fp32 scales stall at 1.88x, under the 1.9x capacity
+    target). The quantize scatter casts the fp32 amax to bf16 at write
+    and every dequant upcasts back to fp32, so page bytes stay a pure
+    function of the token's hidden state (the bit-identity contract).
     """
     if n_blocks < 2:
         raise ValueError("need n_blocks >= 2 (block 0 is the idle scratch)")
@@ -1386,14 +1434,24 @@ def make_paged_kv_pool(
                 f"make_paged_kv_pool(dtype={dtype!r}) conflicts with "
                 "kv_cache_dtype='int8'"
             )
+        sdt = jnp.dtype(scale_dtype or jnp.float32)
+        if sdt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+            raise ValueError(
+                f"int8 pool scale_dtype must be float32 or bfloat16, got {sdt}"
+            )
         sshape = shape[:-1] + (1,)
         fields = {
             "k_pool": (shape, jnp.int8),
             "v_pool": (shape, jnp.int8),
-            "k_scale_pool": (sshape, jnp.float32),
-            "v_scale_pool": (sshape, jnp.float32),
+            "k_scale_pool": (sshape, sdt),
+            "v_scale_pool": (sshape, sdt),
         }
     else:
+        if scale_dtype is not None:
+            raise ValueError(
+                f"make_paged_kv_pool(scale_dtype={scale_dtype!r}) needs "
+                "kv_cache_dtype='int8' (exact pools carry no scale pages)"
+            )
         dtype = jnp.dtype(dtype or cfg.compute_dtype)
         fields = {"k_pool": (shape, dtype), "v_pool": (shape, dtype)}
     if cfg.decode_cache_layout == "unstacked":
@@ -1413,7 +1471,12 @@ def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def _kv_dequantize(q: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
-    return (q.astype(jnp.float32) * (scale * (1.0 / 127.0))).astype(dtype)
+    # Scale upcast FIRST: bf16 scale pools (int8-kv serving) must multiply
+    # in fp32 like the historical fp32 scales do — JAX weak typing would
+    # otherwise compute `scale * (1/127)` in bf16. Bit-wise a no-op for
+    # fp32 scales.
+    scale32 = scale.astype(jnp.float32)
+    return (q.astype(jnp.float32) * (scale32 * (1.0 / 127.0))).astype(dtype)
 
 
 def _materialize_cache(kv: Params, quantized: bool, dtype: Any):

@@ -136,6 +136,7 @@ class CapacitySampler:
         maxlen: int = 512,
         bus: Optional[Any] = None,
         admission_snapshot_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        pool_layout: Optional[Dict[str, Any]] = None,
     ) -> None:
         if maxlen < 1:
             raise ValueError(
@@ -143,6 +144,12 @@ class CapacitySampler:
             )
         self.rows_capacity = int(rows_capacity)
         self.pool_total = int(pool_total)
+        # Static pool byte/dtype identity (ServingEngine.pool_info()):
+        # block COUNTS alone can't be compared across quantize modes — the
+        # same HBM budget holds ~2x the int8-kv blocks — so every window
+        # record carries the dtype and bytes-per-block it was sampled
+        # under, and the offline waterfall can normalize to bytes.
+        self.pool_layout = dict(pool_layout or {})
         self._ring: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self.bus = bus
@@ -240,6 +247,11 @@ class CapacitySampler:
             "cum_rework_prefill_tokens": int(cum_rework_prefill_tokens),
             "cum_preemptions": int(cum_preemptions),
         }
+        if self.pool_layout:
+            rec["kv_dtype"] = self.pool_layout.get("kv_dtype")
+            rec["pool_bytes_per_block"] = self.pool_layout.get(
+                "bytes_per_block"
+            )
         if self.admission_snapshot_fn is not None:
             snap = self.admission_snapshot_fn()
             rec["admission_depth"] = int(snap.get("live_requests", 0))

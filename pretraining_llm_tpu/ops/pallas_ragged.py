@@ -52,11 +52,8 @@ def _ragged_kernel(
     q_ref,  # (1, H*T, Dh) — heads-major fold, query t at row h*T + t
     k_ref,  # (1, bs, G, Dh) — the page tbl[b, j]
     v_ref,  # (1, bs, G, Dh)
-    o_ref,  # (1, H*T, Dh)
-    acc,  # VMEM (H*T, Dh) f32
-    m_scr,  # VMEM (H*T, 1) f32
-    l_scr,  # VMEM (H*T, 1) f32
-    *,
+    *rest,  # quantized: ks_ref, vs_ref (1, bs, G, 1) scale pages, then
+    #         o_ref + the three VMEM scratch refs; exact: o_ref + scratch
     bs: int,
     nb: int,
     g: int,
@@ -64,7 +61,12 @@ def _ragged_kernel(
     t: int,
     scale: float,
     window: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -102,11 +104,26 @@ def _ragged_kernel(
         q = q_ref[0]  # (H*T, Dh)
         k = k_ref[0]  # (bs, G, Dh)
         v = v_ref[0]
+        if quantized:
+            ks = ks_ref[0]  # (bs, G, 1)
+            vs = vs_ref[0]
         for grp in range(g):
             sl = slice(grp * rows, (grp + 1) * rows)
             qg = q[sl]  # (n_rep*T, Dh)
             kg = k[:, grp]  # (bs, Dh)
             vg = v[:, grp]
+            if quantized:
+                # Fused page dequant — the transformer._kv_dequantize
+                # numerics (int8 * fp32-upcast scale / 127), done HERE so
+                # only int8 bytes + scale pages cross HBM. The s/pv dots
+                # below then run in f32 either way (bf16 accumulation
+                # semantics are preserved by preferred_element_type=f32).
+                kg = kg.astype(jnp.float32) * (
+                    ks[:, grp].astype(jnp.float32) * (1.0 / 127.0)
+                )
+                vg = vg.astype(jnp.float32) * (
+                    vs[:, grp].astype(jnp.float32) * (1.0 / 127.0)
+                )
             s = jax.lax.dot_general(
                 qg, kg, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -138,31 +155,41 @@ def _ragged_kernel(
 
 @functools.partial(jax.jit, static_argnames=("t", "window", "interpret"))
 def _ragged_call(q, k_pool, v_pool, block_tables, seq_lens, q_lens, t,
-                 window, interpret):
+                 window, interpret, k_scale=None, v_scale=None):
     b, ht, d = q.shape  # ht == H * T, heads-major fold
     n_blocks, bs, g, _ = k_pool.shape
     nb = block_tables.shape[1]
     n_rep = ht // (g * t)
+    quantized = k_scale is not None
     kernel = functools.partial(
         _ragged_kernel, bs=bs, nb=nb, g=g, n_rep=n_rep, t=t,
-        scale=1.0 / (d**0.5), window=window,
+        scale=1.0 / (d**0.5), window=window, quantized=quantized,
     )
+    page_spec = pl.BlockSpec(
+        (1, bs, g, d),
+        lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, ht, d), lambda bb, j, tbl, seq, ql: (bb, 0, 0)
+        ),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        # Scale pages ride the SAME block-table index map as their K/V
+        # pages — a dead table entry elides all four DMAs together.
+        scale_spec = pl.BlockSpec(
+            (1, bs, g, 1),
+            lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, nb),
-        in_specs=[
-            pl.BlockSpec(
-                (1, ht, d), lambda bb, j, tbl, seq, ql: (bb, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, bs, g, d),
-                lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, bs, g, d),
-                lambda bb, j, tbl, seq, ql: (tbl[bb, j], 0, 0, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, ht, d), lambda bb, j, tbl, seq, ql: (bb, 0, 0)
         ),
@@ -178,7 +205,7 @@ def _ragged_call(q, k_pool, v_pool, block_tables, seq_lens, q_lens, t,
         out_shape=jax.ShapeDtypeStruct((b, ht, d), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      q_lens.astype(jnp.int32), q, k_pool, v_pool)
+      q_lens.astype(jnp.int32), *operands)
 
 
 def ragged_paged_attention(
@@ -191,8 +218,16 @@ def ragged_paged_attention(
     *,
     window: int = 0,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,  # (n_blocks, block_size, G, 1)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ragged paged attention straight off the block pool.
+
+    ``k_scale``/``v_scale`` (both or neither) mark int8 pools: K/V pages
+    hold int8 codes and the scale pools hold each (slot, head)'s amax
+    scale (fp32 or bf16); the kernel dequantizes inside its page loop
+    (transformer._kv_dequantize numerics, fp32 math), so quantized
+    serving never materializes a dequantized pool copy.
 
     One launch serves rows with heterogeneous query counts: row b's
     query t sits at logical slot ``seq_lens[b] + t`` and sees slots
@@ -233,9 +268,18 @@ def ragged_paged_attention(
         )
     if q_lens.shape != (b,):
         raise ValueError(f"q_lens {q_lens.shape} does not match batch {b}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if k_scale is not None:
+        want = k_pool.shape[:-1] + (1,)
+        if k_scale.shape != want or v_scale.shape != want:
+            raise ValueError(
+                f"scale pools must be {want}, got {k_scale.shape} / "
+                f"{v_scale.shape}"
+            )
     out = _ragged_call(
         qf, k_pool, v_pool, block_tables, seq_lens, q_lens, t, int(window),
-        bool(interpret),
+        bool(interpret), k_scale=k_scale, v_scale=v_scale,
     )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -249,13 +293,16 @@ def ragged_gather_attention(
     q_lens: jax.Array,
     *,
     window: int = 0,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """XLA gather fallback: materialize ``pool[tables]`` and run the
     per-query masked softmax — the model's gather branch math with the
     ragged validity term added. ONE source of truth for what the kernel
     must compute; tier-1 CPU tests pin the kernel (interpret mode)
     against this. Pad queries (t >= q_lens[b]) return zeros, matching
-    the kernel's safe-l finalize."""
+    the kernel's safe-l finalize. ``k_scale``/``v_scale`` mirror
+    `ragged_paged_attention`: int8 pools dequantized after the gather."""
     b, t, h, d = q.shape
     g = k_pool.shape[2]
     n_rep = h // g
@@ -263,6 +310,15 @@ def ragged_gather_attention(
     kv_len = block_tables.shape[1] * bs
     ck = k_pool[block_tables].reshape(b, kv_len, g, d)
     cv = v_pool[block_tables].reshape(b, kv_len, g, d)
+    if k_scale is not None:
+        cks = k_scale[block_tables].reshape(b, kv_len, g, 1)
+        cvs = v_scale[block_tables].reshape(b, kv_len, g, 1)
+        ck = ck.astype(jnp.float32) * (
+            cks.astype(jnp.float32) * (1.0 / 127.0)
+        )
+        cv = cv.astype(jnp.float32) * (
+            cvs.astype(jnp.float32) * (1.0 / 127.0)
+        )
     if n_rep > 1:
         ck = jnp.repeat(ck, n_rep, axis=2)
         cv = jnp.repeat(cv, n_rep, axis=2)

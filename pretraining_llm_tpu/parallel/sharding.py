@@ -101,6 +101,32 @@ def param_pspec(
         return P(None, "fsdp")  # (T, D) learned positions
     if parent in ("ln1", "ln2", "final_norm") or name in ("scale",):
         return blk(*([None] * (ndim - (1 if in_blocks else 0))))
+    if name.endswith("_scale") and parent in ("attn", "mlp"):
+        # int8 weight scales (models/quantize.py): shaped like their
+        # weight with the contracted (input) dims collapsed to 1 — shard
+        # the surviving output dims exactly as the weight rule does so a
+        # TP rank holds precisely its output channels' scales; singleton
+        # input dims replicate.
+        base = name[: -len("_scale")]
+        if base == "wqkv":  # (1, 3, H, Dh)
+            return blk(None, None, "tensor", None)
+        if base == "wq":  # (1, H, Dh)
+            return blk(None, "tensor", None)
+        if base == "wkv":  # (1, 2, G, Dh): follows wkv's G-dim decision
+            g = shape[-2] if shape else 0
+            if tensor_size > 1 and g % tensor_size == 0:
+                return blk(None, None, "tensor", None)
+            return blk(None, None, None, None)
+        if base == "wo":  # (1, 1, D)
+            return blk(None, None, "fsdp")
+        if base == "w1":  # (1, F) or (1, 2, F) swiglu
+            if ndim - (1 if in_blocks else 0) == 3:
+                return blk(None, None, "tensor")
+            return blk(None, "tensor")
+        if base == "w2":  # (1, D)
+            return blk(None, "fsdp")
+        # Unknown quantized weight: replicate (any spec is correct).
+        return P(*([None] * ndim))
     if name == "wqkv":  # (D, 3, H, Dh): column-parallel over heads
         return blk("fsdp", None, "tensor", None)
     if name == "bqkv":  # (3, H, Dh)

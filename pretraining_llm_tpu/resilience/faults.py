@@ -377,7 +377,15 @@ class ServingFaultInjector:
         """Overwrite one PUBLISHED prefix-cache pool block with garbage —
         the silent version of a DMA bit-flip on a shared page. Targets the
         lowest cached block id so the drill is deterministic; with no
-        cache (or nothing published yet) it waits for one."""
+        cache (or nothing published yet) it waits for one.
+
+        Poisons EVERY pool leaf at that block: on an exact pool that is
+        K/V; on a quantized pool (kv_cache_dtype=int8 / serving.quantize=
+        int8-kv) it flips both the int8 code pages AND their float scale
+        leaves, so the drill exercises the same detectors — kv_checksum
+        digests (which cover codes and scales alike, see kv_block_digest)
+        verify-on-acquire and golden-probe divergence — on the quantized
+        byte layout."""
         import jax
         import jax.numpy as jnp
 
@@ -393,9 +401,13 @@ class ServingFaultInjector:
             idx = (slice(None), block) if leaf.ndim >= 5 else (block,)
             page = leaf[idx]
             if jnp.issubdtype(page.dtype, jnp.floating):
+                # Exact K/V bytes, or quantization SCALES: 100.0 blows the
+                # dequantized magnitudes far outside any trained range.
                 bad = jnp.full_like(page, 100.0)
             else:
-                bad = jnp.ones_like(page)
+                # int8 quantized codes: a constant nonzero page (sign-flip
+                # would leave an all-zero page — and its digest — intact).
+                bad = jnp.full_like(page, 101)
             return leaf.at[idx].set(bad)
 
         engine.pools = jax.tree_util.tree_map(_poison, engine.pools)

@@ -113,18 +113,25 @@ def build_probe_set(
 
 
 def weight_fingerprint(params: Any) -> float:
-    """One device-side reduction over every floating leaf -> one scalar
-    pull. Position-weighted sums (not abs) so both value corruption and
-    leaf swaps move it; float32 accumulation is deterministic for a fixed
-    tree on a fixed platform, which is all the pinned-vs-current and
-    fleet-wide comparisons need. Cost: one fused reduce + ONE host sync —
-    cheap enough for an interval loop, never on the per-token path."""
+    """One device-side reduction over every floating AND integer leaf ->
+    one scalar pull. Position-weighted sums (not abs) so both value
+    corruption and leaf swaps move it; float32 accumulation is
+    deterministic for a fixed tree on a fixed platform, which is all the
+    pinned-vs-current and fleet-wide comparisons need. Integer leaves are
+    the int8 codes of quantized serving params (models/quantize.py) —
+    excluding them would leave most of a quantized replica's weight bytes
+    outside the detector. Cost: one fused reduce + ONE host sync — cheap
+    enough for an interval loop, never on the per-token path."""
     import jax
     import jax.numpy as jnp
 
     leaves = [
         leaf for leaf in jax.tree_util.tree_leaves(params)
-        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)
+        if hasattr(leaf, "dtype")
+        and (
+            jnp.issubdtype(leaf.dtype, jnp.floating)
+            or jnp.issubdtype(leaf.dtype, jnp.integer)
+        )
     ]
     total = _fingerprint_reduce(leaves)
     return float(np.asarray(total))

@@ -640,3 +640,263 @@ python scripts/obs_report.py --integrity --strict \
     "$OBS_TMP/integrity_events.jsonl" > "$OBS_TMP/integrity_report.out"
 grep -q "detected by" "$OBS_TMP/integrity_report.out" || {
     echo "obs_report --integrity missing the detection attribution"; exit 1; }
+
+# Quantized serving gate: the int8-kv engine behind the full HTTP stack.
+# Weights are quantized ONCE up front (per-channel int8 + scale leaves),
+# the KV pool holds int8 codes + bf16 scales, and the SAME seeded
+# workload (shared prefix + chunked prefill + depth-2 pipelining) run
+# twice must produce bit-identical greedy outputs — determinism is the
+# contract that makes the integrity sentinel's bit-exact probes possible
+# at all. The gate also proves the capacity claim (an equal HBM budget
+# holds strictly more int8-kv blocks than bf16) and that /metrics stays
+# lint-clean with the quant_dtype const-label and the KV-pool-bytes
+# gauges wired.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, threading, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.engine_loop import EngineLoop
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import quantize as quantize_mod
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.observability.spans import SpanRecorder
+from pretraining_llm_tpu.observability.tracing import Tracer
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+qparams = quantize_mod.quantize_params_for_serving(params, cfg)
+
+# Capacity claim at equal HBM: blocks the int8-kv layout fits into the
+# bf16 pool's byte budget must strictly exceed the bf16 block count.
+eng_bf = ServingEngine(params, cfg, max_batch=2, n_blocks=24, block_size=8,
+                       temperature=0.0)
+eng_q = ServingEngine(qparams, cfg, max_batch=2, n_blocks=24, block_size=8,
+                      temperature=0.0, quantize="int8-kv")
+info_bf, info_q = eng_bf.pool_info(), eng_q.pool_info()
+assert info_q["kv_dtype"] == "int8", info_q
+assert info_q["kv_scale_dtype"] == "bfloat16", info_q
+assert info_q["bytes_per_block"] < info_bf["bytes_per_block"], (info_q, info_bf)
+blocks_at_budget = info_bf["pool_bytes"] // info_q["bytes_per_block"]
+assert blocks_at_budget > info_bf["n_blocks"], (blocks_at_budget, info_bf)
+del eng_bf, eng_q
+
+head = [7, 3, 11, 2, 19, 5, 23, 1, 13, 4, 17, 6]   # shared 12-token prefix
+prompts = [head + [31 + 7 * i, 41 + 3 * i, 9 + i][: 2 + i % 3]
+           for i in range(8)]
+
+def run_stack(tag):
+    eng = ServingEngine(qparams, cfg, max_batch=2, n_blocks=24, block_size=8,
+                        temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                        prefix_cache=True, prefill_chunk_tokens=6,
+                        quantize="int8-kv")
+    bus = EventBus(os.path.join(tmp, f"quant_events_{tag}.jsonl"))
+    registry = MetricsRegistry("pllm_serving_",
+                               const_labels={"quant_dtype": "int8-kv"})
+    loop = EngineLoop(eng, admission=AdmissionController(max_queue_depth=16),
+                      bus=bus, tracer=Tracer(SpanRecorder(), sample=1.0,
+                                             seed=13),
+                      registry=registry)
+    gw = ServingGateway(loop, port=0)
+    loop.start(); gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    outs = {}
+    def post(i, p):
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"prompt": p, "max_new_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            outs[i] = json.loads(r.read())
+    threads = [threading.Thread(target=post, args=(i, p))
+               for i, p in enumerate(prompts)]
+    for t in threads: t.start()
+    for t in threads: t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "a quantized request hung"
+    assert all(outs[i]["status"] == "done" and len(outs[i]["tokens"]) == 8
+               for i in range(len(prompts))), outs
+    with urllib.request.urlopen(f"{base}/debug/engine", timeout=30) as r:
+        dbg = json.loads(r.read())
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    gw.stop(); loop.stop(); bus.close()
+    return [outs[i]["tokens"] for i in range(len(prompts))], dbg, text
+
+out1, dbg, text = run_stack("run1")
+out2, _, _ = run_stack("run2")
+assert out1 == out2, "int8-kv greedy outputs are not run-to-run identical"
+
+layout = dbg["pool_layout"]
+assert layout["quantize"] == "int8-kv", layout
+assert layout["kv_dtype"] == "int8", layout
+problems = lint_exposition(text)
+assert not problems, problems
+assert 'quant_dtype="int8-kv"' in text, text[:400]
+assert "pllm_serving_kv_pool_bytes" in text, text[:400]
+assert "pllm_serving_kv_pool_bytes_per_block" in text, text[:400]
+print(f"quantized smoke ok: {len(prompts)} bit-identical requests, "
+      f"{layout['bytes_per_block']}B/block int8-kv vs "
+      f"{info_bf['bytes_per_block']}B/block bf16 "
+      f"({blocks_at_budget} blocks at the bf16 budget)")
+EOF
+
+# The capacity auditor must accept the quantized run with --strict: the
+# cap_window records now carry the pool's dtype/bytes-per-block identity,
+# and the waterfall must still sum and join as before.
+python scripts/obs_report.py --capacity --strict \
+    "$OBS_TMP/quant_events_run1.jsonl" > "$OBS_TMP/quant_capacity_report.out"
+grep -q "binding constraint:" "$OBS_TMP/quant_capacity_report.out" || {
+    echo "obs_report --capacity missing the binding constraint (quantized)"; exit 1; }
+
+# Quantized sentinel gate: the corrupt_weights drill on an int8-kv fleet.
+# Both replicas serve the SAME pre-quantized params (one quantization up
+# front is what keeps the fleet's weight fingerprints and golden probes
+# unanimous); the probes are therefore pinned WITHIN the quantized graph
+# and compared quantized-vs-quantized, bit-for-bit. Negating a weight
+# leaf on replica 0 must trip the sentinel (fingerprint drift / probe
+# divergence), quarantine the replica, and redrive its in-flight long
+# request to the survivor. Tokens committed inside the detection window
+# ran on corrupted weights — that latency is the sentinel's documented
+# cost — so bit-identity is asserted where the contract actually holds:
+# a post-recovery replay of the whole workload on the healed fleet must
+# match a clean single-engine int8-kv reference exactly.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, threading, time, urllib.request
+import jax
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import quantize as quantize_mod
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+qparams = quantize_mod.quantize_params_for_serving(params, cfg)
+
+prompts = [[7, 3, 11, 2, 19, 5] + [31 + 7 * i, 9 + i] for i in range(6)]
+
+# Clean reference: every prompt through a single healthy int8-kv engine.
+ref_eng = ServingEngine(qparams, cfg, max_batch=2, n_blocks=24, block_size=8,
+                        temperature=0.0, steps_per_sched=4,
+                        quantize="int8-kv")
+rids = [ref_eng.submit(p, 8) for p in prompts]
+ref_out = ref_eng.run()
+reference = [ref_out[r] for r in rids]
+del ref_eng
+
+def make_engine():
+    return ServingEngine(qparams, cfg, max_batch=2, n_blocks=24, block_size=8,
+                         temperature=0.0, steps_per_sched=4, pipeline_depth=2,
+                         prefix_cache=True, quantize="int8-kv")
+
+bus = EventBus(os.path.join(tmp, "quant_integrity_events.jsonl"))
+faults = ServingFaultInjector("corrupt_weights@req1:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_",
+                           const_labels={"quant_dtype": "int8-kv"})
+replicas = [
+    Replica(i, make_engine, bus=bus, fault_injector=faults,
+            registry_labels={"quant_dtype": "int8-kv"})
+    for i in range(2)
+]
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=0.2, probe_interval_s=0.05,
+                probe_timeout_s=60.0).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+def post(p, max_new, out, key):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": p, "max_new_tokens": max_new}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as r:
+        out[key] = json.loads(r.read())
+
+# A long decode pinned in flight while the drill lands: short requests
+# walk replica 0's per-replica request count up to the fault trigger,
+# and the long one must survive its replica's quarantine via redrive.
+drill = {}
+long_t = threading.Thread(target=post, args=(prompts[0], 48, drill, "long"))
+long_t.start()
+for i in range(4):
+    post(prompts[1 + i % 4], 4, drill, f"warm{i}")
+    if router.counters["quarantines"]:
+        break
+
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if router.counters["quarantines"] >= 1:
+        break
+    time.sleep(0.05)
+assert router.counters["quarantines"] >= 1, router.counters
+long_t.join(timeout=180)
+assert not long_t.is_alive(), "the in-flight long request hung"
+assert drill["long"]["status"] == "done", drill["long"]
+assert len(drill["long"]["tokens"]) == 48, len(drill["long"]["tokens"])
+assert drill["long"].get("redrives", 0) >= 1, drill["long"]
+
+# The quarantined replica must relaunch (fresh quantized weights, clean
+# pool) and re-pass the quantized-pinned probe/fingerprint checks.
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline:
+    if (all(rep.accepting for rep in router.replicas)
+            and router.replicas[0].generation >= 2):
+        break
+    time.sleep(0.05)
+assert router.replicas[0].generation >= 2, router.replicas[0].debug_snapshot()
+
+# Post-recovery replay: the healed fleet must be bit-identical to the
+# clean int8-kv reference on every prompt.
+replay = {}
+threads = [threading.Thread(target=post, args=(p, 8, replay, i))
+           for i, p in enumerate(prompts)]
+for t in threads: t.start()
+for t in threads: t.join(timeout=180)
+assert not any(t.is_alive() for t in threads), "a replay request hung"
+for i, want in enumerate(reference):
+    got = replay[i]
+    assert got["status"] == "done", got
+    assert got["tokens"] == want, (i, got["tokens"], want)
+
+with urllib.request.urlopen(f"{base}/debug/engine", timeout=30) as r:
+    dbg = json.loads(r.read())
+integ = dbg["fleet"]["integrity"]
+assert integ["enabled"] and integ["quarantines"] >= 1, integ
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert 'quant_dtype="int8-kv"' in text, text[:400]
+assert "pllm_serving_integrity_probes_total" in text, text[:400]
+assert "pllm_serving_quarantines_total" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"quantized sentinel smoke ok: quarantines="
+      f"{router.counters['quarantines']}, "
+      f"redrives={router.counters['redrives']}, "
+      f"{len(prompts)} replayed prompts bit-identical")
+EOF
+
+# The integrity auditor must accept the quantized drill with --strict:
+# the fired corruption attributed to a detector, every divergence
+# answered, no unanswered quarantine.
+python scripts/obs_report.py --integrity --strict \
+    "$OBS_TMP/quant_integrity_events.jsonl" \
+    > "$OBS_TMP/quant_integrity_report.out"
+grep -q "detected by" "$OBS_TMP/quant_integrity_report.out" || {
+    echo "obs_report --integrity missing the detection attribution (quantized)"; exit 1; }

@@ -142,6 +142,15 @@ def main() -> None:
                         help="(--http) watchdog: eject a replica whose loop "
                         "has active requests but no completed scheduler turn "
                         "for this long; 0 = disabled (default: config)")
+    parser.add_argument("--quantize", default="",
+                        choices=["", "none", "int8", "int8-kv"],
+                        help="serving quantization: 'int8' = per-channel "
+                        "int8 weights (attention/FFN projections, bf16 "
+                        "accumulation); 'int8-kv' = int8 weights AND int8 "
+                        "KV pool pages with bf16 per-token scales (~1.9x "
+                        "block capacity at head_dim 64). Greedy outputs "
+                        "are deterministic within the quantized graph but "
+                        "differ from the bf16 graph (default: config)")
     parser.add_argument("--kv_checksum", action="store_true",
                         help="verify prefix-cache KV pages against digests "
                         "recorded at publish; a corrupted shared page is "
@@ -192,8 +201,18 @@ def main() -> None:
             draft_cfg=d_cfg.model, spec_k=args.spec_k,
         )
 
+    quantize = args.quantize or cfg.serving.quantize
+
     # A factory, not an engine: the fleet path builds one engine per
     # replica, and a crashed replica relaunches with a FRESH engine.
+    # With quantization on, quantize ONCE here (not per replica): every
+    # replica then serves the same int8 codes + scales, so fleet-wide
+    # fingerprint comparison and probe unanimity stay meaningful.
+    if quantize != "none":
+        from pretraining_llm_tpu.models import quantize as quantize_mod
+
+        params = quantize_mod.quantize_params_for_serving(params, cfg.model)
+
     def make_engine():
         return ServingEngine(
             params, cfg.model,
@@ -213,6 +232,7 @@ def main() -> None:
                 args.prefill_chunk_tokens or cfg.serving.prefill_chunk_tokens
             ),
             kv_checksum=args.kv_checksum or cfg.serving.kv_checksum,
+            quantize=quantize,
             **spec,
         )
 
@@ -297,7 +317,12 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     tracer = None
     if trace_sample > 0:
         tracer = Tracer(get_recorder(), sample=trace_sample, seed=args.seed)
-    registry = MetricsRegistry(prefix="pllm_serving_")
+    # quant_dtype rides every serving series as a const-label so dashboards
+    # can split bf16 vs quantized fleets without a scrape-config change.
+    quantize = args.quantize or cfg.serving.quantize
+    registry = MetricsRegistry(
+        prefix="pllm_serving_", const_labels={"quant_dtype": quantize}
+    )
     n_replicas = pick(args.replicas, fc.replicas)
     fault_spec = pick(args.serving_faults, fc.serving_faults)
     faults = (
@@ -322,6 +347,7 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
         replicas = [
             Replica(
                 i, make_engine, bus=bus, tracer=tracer,
+                registry_labels={"quant_dtype": quantize},
                 admission_factory=make_admission, fault_injector=faults,
                 loop_kwargs=dict(
                     idle_wait_s=fc.idle_wait_s, capacity_ring=fc.capacity_ring,

@@ -508,8 +508,10 @@ def test_paged_kernel_config_validation():
 
     with pytest.raises(ValueError, match="gather' or 'kernel"):
         ModelConfig(paged_attention_impl="magic")
-    with pytest.raises(ValueError, match="int8"):
-        ModelConfig(paged_attention_impl="kernel", kv_cache_dtype="int8")
+    # kernel + int8 pools is a supported combination (the ragged kernel
+    # fuses the scale-page dequant into its page loop) — must construct.
+    cfg = ModelConfig(paged_attention_impl="kernel", kv_cache_dtype="int8")
+    assert cfg.kv_cache_dtype == "int8"
 
 
 DRAFT_CFG = dataclasses.replace(CFG, n_layers=1, d_model=16, n_heads=2)

@@ -144,6 +144,68 @@ def test_ragged_kernel_bf16():
     )
 
 
+def _quantize_pool(x):
+    """The engine's KV page convention (transformer._kv_quantize): int8
+    codes with a per-(token, head) amax scale over the channel dim."""
+    scale = np.maximum(np.abs(x).max(axis=-1, keepdims=True), 1e-8)
+    q = np.asarray(
+        jnp.round(jnp.asarray(x / scale * 127.0)), np.float32
+    ).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale.astype(np.float32))
+
+
+@pytest.mark.parametrize("scale_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("g,window", [(8, 0), (2, 0), (4, 12), (1, 0)])
+def test_ragged_kernel_int8_pool_grid(g, window, scale_dtype):
+    """Quantized pools over the same identity grid as the exact-pool
+    case: the kernel's fused in-loop dequant must match the gather
+    reference (which dequantizes after assembly) to accumulation-order
+    tolerance, and both must sit within quantization distance of the
+    exact-pool answer. Covers both scale-pool dtypes the engine
+    allocates (f32 legacy int8, bf16 int8-kv)."""
+    rng = np.random.default_rng(1000 + g * 100 + window)
+    b, t, h, d, bs, n_blocks, max_blocks = 3, 6, 8, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    vf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    kq, ks = _quantize_pool(kf)
+    vq, vs = _quantize_pool(vf)
+    ks = ks.astype(scale_dtype)
+    vs = vs.astype(scale_dtype)
+    tables, seq, qlens = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    args = (jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens))
+    out = ragged_paged_attention(
+        q, kq, vq, *args, window=window, k_scale=ks, v_scale=vs
+    )
+    assert out.shape == (b, t, h, d)
+    ref = ragged_gather_attention(
+        q, kq, vq, *args, window=window, k_scale=ks, v_scale=vs
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # Same state through EXACT pools: the quantized answer must stay
+    # within int8 noise of it (softmax-weighted ~1/127-scale values).
+    exact = ragged_gather_attention(
+        q, jnp.asarray(kf), jnp.asarray(vf), *args, window=window
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exact), atol=0.08)
+
+
+def test_ragged_int8_scale_validation():
+    q = jnp.zeros((2, 3, 4, 64))
+    kp = jnp.zeros((8, 8, 2, 64), jnp.int8)
+    sc = jnp.ones((8, 8, 2, 1))
+    tbl = jnp.zeros((2, 2), jnp.int32)
+    seq = jnp.zeros((2,), jnp.int32)
+    ql = jnp.ones((2,), jnp.int32)
+    with pytest.raises(ValueError, match="k_scale"):
+        ragged_paged_attention(q, kp, kp, tbl, seq, ql, k_scale=sc)
+    with pytest.raises(ValueError, match="scale"):
+        ragged_paged_attention(
+            q, kp, kp, tbl, seq, ql,
+            k_scale=jnp.ones((8, 8, 2)), v_scale=jnp.ones((8, 8, 2)),
+        )
+
+
 def test_ragged_matches_uniform_reference_on_uniform_batch():
     """With every q_len == t the ragged mask degenerates to the uniform
     multi-token mask — pin it against test_pallas_paged's reference math

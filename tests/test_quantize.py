@@ -1,0 +1,373 @@
+"""Int8 serving quantization: per-channel weights, int8 KV pool, identity.
+
+Three layers of guarantees:
+
+  unit      quantize/dequantize roundtrip is bounded by half a scale step
+            per element over a property grid (random, outlier rows, zero
+            rows), and the param-tree transform touches exactly the
+            serving projections (embeddings/norms/lm_head stay floating);
+  capacity  the int8-kv pool (int8 pages + bf16 per-token scales) packs
+            >= 1.9x the blocks of the bf16 pool at head_dim 64 for the
+            same HBM budget — the headline the mode exists for;
+  identity  greedy serving output is DETERMINISTIC WITHIN the quantized
+            graph: bit-equal across pipeline depths 1-3 x prefix-cache
+            on/off x chunked prefill on/off, and a corrupted shared page
+            under kv_checksum is dropped and re-prefilled with no output
+            divergence. Quantized output is never compared against the
+            bf16 graph bit-for-bit — only against itself (the sentinel
+            pins probes the same way).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation.generate import generate
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import quantize, transformer
+from pretraining_llm_tpu.resilience import integrity
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def qparams(params):
+    return quantize.quantize_params_for_serving(params, CFG)
+
+
+# -- quantize/dequantize roundtrip property grid -----------------------------
+
+
+def _grid_weight(case, shape, rng):
+    w = rng.normal(size=shape).astype(np.float32)
+    if case == "outlier":
+        # One huge element per output channel stresses the per-channel
+        # scale: everything else in that channel collapses toward zero
+        # codes, but the bound below must still hold.
+        flat = w.reshape(-1, shape[-1])
+        flat[0] *= 1e4
+    elif case == "zero":
+        # Whole zero channels: scale clamps at eps instead of dividing
+        # by zero, and dequantized zeros stay exactly zero.
+        w[..., : shape[-1] // 2] = 0.0
+    return jnp.asarray(w)
+
+
+@pytest.mark.parametrize("case", ["normal", "outlier", "zero"])
+@pytest.mark.parametrize(
+    "shape,axes",
+    [
+        ((16, 24), (0,)),                # plain (D, F)
+        ((3, 10, 2, 4, 6), (1,)),        # stacked (L, D, 2, G, Dh)
+        ((2, 4, 6, 12), (1, 2)),         # stacked wo (L, H, Dh, D)
+    ],
+)
+def test_quantize_roundtrip_bound(case, shape, axes):
+    rng = np.random.default_rng(hash((case, shape)) % 2**31)
+    w = _grid_weight(case, shape, rng)
+    q, scale = quantize.quantize_weight(w, axes)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+    expect_scale = tuple(
+        1 if ax in axes else n for ax, n in enumerate(shape)
+    )
+    assert scale.shape == expect_scale
+    assert int(jnp.max(jnp.abs(q.astype(jnp.int32)))) <= 127
+    deq = quantize.dequantize_weight(q, scale, jnp.float32)
+    # Symmetric rounding: each element lands within half a quantization
+    # step of its channel, whatever the channel's dynamic range.
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    # One code step spans `scale` (= amax/127) in weight space.
+    bound = np.broadcast_to(np.asarray(scale) * (0.5 + 1e-3), shape)
+    assert np.all(err <= bound + 1e-30), float((err - bound).max())
+    if case == "zero":
+        assert np.all(np.asarray(deq)[..., : shape[-1] // 2] == 0.0)
+
+
+def test_quantize_params_structure(params, qparams):
+    assert not quantize.is_quantized(params)
+    assert quantize.is_quantized(qparams)
+    blk = qparams["blocks"]
+    for name in ("wqkv",) if "wqkv" in blk["attn"] else ("wq", "wkv"):
+        assert blk["attn"][name].dtype == jnp.int8
+        scale = blk["attn"][name + "_scale"]
+        assert scale.dtype == jnp.float32
+    assert blk["mlp"]["w1"].dtype == jnp.int8
+    assert blk["mlp"]["w2"].dtype == jnp.int8
+    # Embeddings / norms / biases stay floating — they are tiny and their
+    # precision anchors the residual stream.
+    assert jnp.issubdtype(
+        qparams["tok_embed"]["embedding"].dtype, jnp.floating
+    )
+    for norm in ("ln1", "ln2"):
+        for leaf in jax.tree_util.tree_leaves(blk[norm]):
+            assert jnp.issubdtype(leaf.dtype, jnp.floating)
+    # The transform did not mutate its input tree.
+    assert params["blocks"]["mlp"]["w1"].dtype != jnp.int8
+    # Quantized model bytes shrink (int8 codes + small scale leaves).
+    assert quantize.param_bytes(qparams) < quantize.param_bytes(params)
+
+
+def test_quantize_rejects_moe(params):
+    moe_cfg = dataclasses.replace(CFG, n_experts=4)
+    with pytest.raises(ValueError, match="[Mm]o[Ee]|experts"):
+        quantize.quantize_params_for_serving(params, moe_cfg)
+
+
+def test_quantized_forward_close_to_exact(params, qparams):
+    """Not bit-equal — int8 is lossy — but the logits must stay close on
+    the scale of their own spread (the accuracy caveat README documents)."""
+    tok = jnp.asarray(
+        np.random.default_rng(0).integers(0, CFG.vocab_size, size=(2, 12)),
+        jnp.int32,
+    )
+    exact, _ = transformer.forward(params, tok, CFG)
+    quant, _ = transformer.forward(qparams, tok, CFG)
+    spread = float(jnp.max(exact) - jnp.min(exact))
+    diff = float(jnp.max(jnp.abs(exact - quant)))
+    assert diff < 0.05 * spread, (diff, spread)
+
+
+# -- pool capacity -----------------------------------------------------------
+
+
+def test_int8_kv_pool_capacity_ratio_at_dh64():
+    """At head_dim 64 the int8-kv layout (int8 pages + bf16 per-token
+    scales) must hold >= 1.9x the blocks of the bf16 pool for the same
+    byte budget — the acceptance bar for the mode."""
+    cfg = dataclasses.replace(CFG, d_model=256)
+    assert cfg.head_dim == 64, "grid assumes Dh=64"
+    bf16 = transformer.make_paged_kv_pool(cfg, 4, BS, dtype="bfloat16")
+    q8 = transformer.make_paged_kv_pool(
+        dataclasses.replace(cfg, kv_cache_dtype="int8"), 4, BS,
+        scale_dtype="bfloat16",
+    )
+
+    def pool_bytes(pools):
+        return sum(l.nbytes for l in jax.tree_util.tree_leaves(pools))
+
+    ratio = pool_bytes(bf16) / pool_bytes(q8)
+    assert ratio >= 1.9, ratio
+    # f32 scales would NOT clear the bar (Dh+4 per token vs Dh+2): the
+    # scale dtype is a load-bearing choice, pin it.
+    q8_f32 = transformer.make_paged_kv_pool(
+        dataclasses.replace(cfg, kv_cache_dtype="int8"), 4, BS,
+        scale_dtype="float32",
+    )
+    assert pool_bytes(bf16) / pool_bytes(q8_f32) < 1.9
+
+
+def test_engine_pool_info_reports_layout(params):
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=16, block_size=BS,
+        temperature=0.0, quantize="int8-kv",
+    )
+    info = eng.pool_info()
+    assert info["quantize"] == "int8-kv"
+    assert info["kv_dtype"] == "int8"
+    assert info["kv_scale_dtype"] == "bfloat16"
+    assert info["n_blocks"] == 16 and info["block_size"] == BS
+    assert info["pool_bytes"] == info["bytes_per_block"] * 16
+    exact = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=16, block_size=BS,
+        temperature=0.0,
+    )
+    xinfo = exact.pool_info()
+    assert xinfo["quantize"] == "none" and xinfo["kv_scale_dtype"] is None
+    assert xinfo["bytes_per_block"] > info["bytes_per_block"]
+
+
+# -- greedy identity within the quantized graph ------------------------------
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)]))
+        .tolist()
+        for i in range(n)
+    ]
+
+
+def _serve(params, prompts, n_new, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_blocks", 24)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("steps_per_sched", 4)
+    eng = ServingEngine(params, CFG, temperature=0.0, **kw)
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return [out[r] for r in sorted(rids, key=rids.get)]
+
+
+def test_int8_weights_only_matches_quantized_generate(params, qparams):
+    """quantize='int8' leaves the KV pool exact, so the serving engine
+    must reproduce the reference generate path run on the SAME quantized
+    params bit-for-bit — the identity chain that anchors every other
+    serving test, shifted into the quantized graph."""
+    prompts = _prompts(3)
+    n_new = 8
+    got = _serve(params, prompts, n_new, quantize="int8")
+    for p, toks in zip(prompts, got):
+        ref = generate(
+            qparams, CFG, jnp.asarray([p], jnp.int32), n_new,
+            jax.random.key(7), temperature=0.0,
+        )
+        assert toks == np.asarray(ref)[0].tolist()
+
+
+def test_int8_kv_bit_identity_grid(params):
+    """The acceptance grid: pipeline depths 1-3 x prefix-cache on/off x
+    chunked prefill on/off, all bit-equal to each other (and run-to-run)
+    WITHIN the int8-kv graph. Scheduling and caching may change which
+    lane computes a token, never its value."""
+    prompts = _prompts(4)
+    n_new = 8
+    base = _serve(params, prompts, n_new, quantize="int8-kv")
+    assert base == _serve(params, prompts, n_new, quantize="int8-kv")
+    for depth in (1, 2, 3):
+        for pfx in (False, True):
+            for chunk in (0, BS):
+                got = _serve(
+                    params, prompts, n_new, quantize="int8-kv",
+                    pipeline_depth=depth, prefix_cache=pfx,
+                    prefill_chunk_tokens=chunk,
+                )
+                assert got == base, (depth, pfx, chunk)
+
+
+def test_int8_kv_prequantized_params_accepted(params, qparams):
+    """An engine handed already-quantized params (the fleet path: serve.py
+    quantizes once, N replicas share the tree) must not re-quantize and
+    must produce the same outputs as one that quantizes internally."""
+    prompts = _prompts(2)
+    a = _serve(params, prompts, 6, quantize="int8-kv")
+    b = _serve(qparams, prompts, 6, quantize="int8-kv")
+    assert a == b
+
+
+# -- integrity: fingerprints, digests, corrupt-page drill --------------------
+
+
+def test_weight_fingerprint_covers_int8_codes(qparams):
+    fp = integrity.weight_fingerprint(qparams)
+    mutated = jax.tree_util.tree_map(lambda x: x, qparams)
+    blk = dict(mutated["blocks"])
+    mlp = dict(blk["mlp"])
+    mlp["w1"] = mlp["w1"].at[(0,) * mlp["w1"].ndim].add(3)
+    blk["mlp"] = mlp
+    mutated = {**mutated, "blocks": blk}
+    assert integrity.weight_fingerprint(mutated) != fp
+
+
+def test_corrupt_weights_fires_on_quantized_replica(params):
+    """The sentinel drill's corruption primitive must still find a
+    floating leaf to negate on a quantized engine (the embedding stays
+    bf16/f32) and the fingerprint must move."""
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=16, block_size=BS,
+        temperature=0.0, quantize="int8-kv",
+    )
+    fp = integrity.weight_fingerprint(eng.params)
+    assert ServingFaultInjector._fire_corrupt_weights(eng)
+    assert integrity.weight_fingerprint(eng.params) != fp
+
+
+def _shared_prefix_prompts(n, prefix_blocks=2, tail=(3, 5, 2, 6, 4, 1)):
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, CFG.vocab_size, size=prefix_blocks * BS).tolist()
+    return [
+        prefix
+        + rng.integers(0, CFG.vocab_size, size=int(tail[i % len(tail)]))
+        .tolist()
+        for i in range(n)
+    ]
+
+
+def test_corrupt_quantized_page_dropped_bit_identically(params):
+    """corrupt_kv_page on an int8-kv pool flips quantized code pages AND
+    their scale leaves; verify-on-acquire (kv_checksum) must drop the
+    page and re-prefill privately with outputs bit-equal to the
+    undisturbed quantized run."""
+    prompts = _shared_prefix_prompts(4)
+    n_new = 6
+    ref = _serve(params, prompts * 2, n_new, quantize="int8-kv",
+                 prefix_cache=False)
+
+    eng = ServingEngine(
+        params, CFG, max_batch=2, n_blocks=24, block_size=BS,
+        steps_per_sched=4, temperature=0.0, quantize="int8-kv",
+        prefix_cache=True, kv_checksum=True,
+    )
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = {rids[r]: t for r, t in eng.run().items()}
+    cached = eng.prefix_cache.cached_block_ids()
+    assert cached
+    before = integrity.kv_block_digest(eng.pools, cached[0])
+    assert ServingFaultInjector._fire_corrupt_kv_page(eng)
+    # The digest moved: the poison reached the quantized bytes/scales.
+    assert integrity.kv_block_digest(eng.pools, cached[0]) != before
+    rids2 = {eng.submit(p, n_new): len(prompts) + i
+             for i, p in enumerate(prompts)}
+    out.update({rids2[r]: t for r, t in eng.run().items() if r in rids2})
+    assert eng.stats.get("kv_mismatches", 0) >= 1
+    for i in range(len(prompts) * 2):
+        assert out[i] == ref[i], f"request {i} diverged past a corrupt page"
+
+
+def test_golden_probes_pin_within_quantized_graph(qparams):
+    """build_probe_set on quantized params pins quantized-graph
+    continuations: re-running the probes on the same tree is bit-equal;
+    running them on a differently-corrupted tree diverges (what the
+    router's quarantine drill keys on)."""
+    probes = integrity.build_probe_set(
+        qparams, CFG, n_probes=2, probe_len=9, max_new=4
+    )
+    again = integrity.build_probe_set(
+        qparams, CFG, n_probes=2, probe_len=9, max_new=4
+    )
+    assert [p.expected for p in probes] == [p.expected for p in again]
+
+
+# -- config / sharding plumbing ---------------------------------------------
+
+
+def test_serving_config_validates_quantize():
+    from pretraining_llm_tpu.config import ServingConfig
+
+    ServingConfig(quantize="int8-kv")
+    with pytest.raises(ValueError, match="serving.quantize"):
+        ServingConfig(quantize="fp4")
+
+
+def test_scale_leaves_get_pspecs(qparams):
+    """Every *_scale leaf must resolve to a PartitionSpec of its own rank
+    so shard_params_for_inference can lay the quantized tree out on a TP
+    mesh without falling through to a mis-ranked weight rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from pretraining_llm_tpu.parallel.sharding import param_pspec_tree
+
+    specs = param_pspec_tree(qparams, tensor_size=2)
+    flat_p = jax.tree_util.tree_flatten_with_path(qparams)[0]
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert len(flat_p) == len(flat_s)
+    for (path, leaf), spec in zip(flat_p, flat_s):
+        spec_t = tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))
+        assert len(spec_t) == leaf.ndim, (path, spec)
+        # A sharded dim must divide evenly on this leaf for tensor=2.
+        for ax, name in enumerate(spec_t):
+            if name == "tensor":
+                assert leaf.shape[ax] % 2 == 0, (path, spec, leaf.shape)
