@@ -261,12 +261,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--fleet-scenario", default="kill",
-        choices=["kill", "rolling", "hotprefix"],
+        choices=["kill", "rolling", "hotprefix", "upgrade", "proc-kill"],
         help="serving-fleet mode: kill = deterministic replica_crash on "
         "replica 0 one third into the burst (redrive drill); rolling = "
         "drain/restore each replica in turn under load; hotprefix = "
         "zipf-skewed shared-prefix traffic, measuring prefix-affinity "
-        "placement (per-replica spread, no faults)",
+        "placement (per-replica spread, no faults); upgrade = probe-vetted "
+        "rolling weight upgrade of every replica while the burst runs "
+        "(zero client-visible errors expected); proc-kill = out-of-process "
+        "worker fleet (RemoteReplica), SIGKILL worker 0 mid-burst and "
+        "measure redrive + relaunch across a real process death",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -829,16 +833,20 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     """Online latency under load through the N-replica fleet Router while
     a scenario disturbance runs: 'kill' crashes replica 0 mid-burst (the
     router ejects it, redrives its in-flight requests to survivors and
-    relaunches it), 'rolling' drains/restores every replica in turn, and
+    relaunches it), 'rolling' drains/restores every replica in turn,
     'hotprefix' sends zipf-skewed shared-prefix traffic to measure
-    prefix-affinity placement. Reports goodput plus the fleet-only
-    numbers: redrive count/cost, ejects, per-replica request spread."""
+    prefix-affinity placement, 'upgrade' rolls a probe-vetted weight
+    upgrade across every replica under load, and 'proc-kill' runs the
+    fleet as out-of-process workers and SIGKILLs one mid-burst. Reports
+    goodput plus the fleet-only numbers: redrive count/cost, ejects,
+    per-replica request spread."""
     import jax
 
     from pretraining_llm_tpu.config import get_preset
     from pretraining_llm_tpu.frontend.admission import AdmissionController
     from pretraining_llm_tpu.frontend.loadgen import (
-        LoadSpec, rolling_restart_plan, run_engine_loop, run_fleet_plan,
+        FleetAction, LoadSpec, rolling_restart_plan, run_engine_loop,
+        run_fleet_plan,
     )
     from pretraining_llm_tpu.frontend.replica import Replica
     from pretraining_llm_tpu.frontend.router import Router
@@ -910,27 +918,67 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         )
 
     faults = None
+    kill_at = max(2, n_requests // (3 * args.replicas))
     if args.fleet_scenario == "kill":
         # Crash replica 0 when it accepts its (n/3)th request — mid-burst
         # by construction, deterministic under the seeded schedule.
-        kill_at = max(2, n_requests // (3 * args.replicas))
         faults = ServingFaultInjector(f"replica_crash@req{kill_at}:r0")
 
-    replicas = [
-        Replica(
-            i, make_engine, fault_injector=faults,
-            admission_factory=lambda reg: AdmissionController(
-                max_queue_depth=4 * max_batch, registry=reg
-            ),
-        )
-        for i in range(args.replicas)
-    ]
+    if args.fleet_scenario == "proc-kill":
+        # Out-of-process fleet: each replica is a worker subprocess that
+        # inits the SAME params from the same (preset, init_seed=0) the
+        # parent's decode_bench_workload used, so redriven requests land
+        # on bit-identical weights. worker_kill is a real SIGKILL,
+        # executed by the parent injector right after replica 0 acks its
+        # kill_at'th submit.
+        from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+
+        faults = ServingFaultInjector(f"worker_kill@req{kill_at}:r0")
+        worker_spec = {
+            "preset": args.preset,
+            "init_seed": 0,
+            "model_overrides": {
+                "attention_impl": cfg.attention_impl,
+                "sequence_parallel": cfg.sequence_parallel,
+                "kv_cache_dtype": cfg.kv_cache_dtype,
+                "paged_attention_impl": cfg.paged_attention_impl,
+                "decode_cache_layout": cfg.decode_cache_layout,
+            },
+            "engine": {
+                "max_batch": max_batch, "n_blocks": n_blocks,
+                "block_size": block_size, "temperature": 0.0,
+                "steps_per_sched": sps, "pipeline_depth": depth,
+                "admit_batch": args.admit_batch,
+                "prefix_cache": args.prefix_cache,
+            },
+            "admission": {"max_queue_depth": 4 * max_batch},
+        }
+        replicas = [
+            RemoteReplica(i, worker_spec, fault_injector=faults)
+            for i in range(args.replicas)
+        ]
+    else:
+        replicas = [
+            Replica(
+                i, make_engine, fault_injector=faults,
+                admission_factory=lambda reg: AdmissionController(
+                    max_queue_depth=4 * max_batch, registry=reg
+                ),
+            )
+            for i in range(args.replicas)
+        ]
     router = Router(
         replicas,
         admission=AdmissionController(
             max_queue_depth=4 * max_batch * args.replicas
         ),
         eject_backoff_s=0.2,
+        # The upgrade drill vets new weights against golden probes before
+        # they take traffic; a pinned probe set requires the sentinel to
+        # be on (interval far beyond the burst keeps it out of the way).
+        probe_interval_s=(
+            60.0 if args.fleet_scenario == "upgrade" else 0.0
+        ),
     )
     spec = LoadSpec(
         n_requests=n_requests, mode="open", rate_rps=args.rate_rps,
@@ -960,6 +1008,22 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
                     step_s=max(0.5, 0.5 * est_wall / args.replicas),
                 ),
             )
+        elif args.fleet_scenario == "upgrade":
+            # Probe-vetted rolling upgrade of every replica, staggered
+            # across the middle of the burst (update=None relaunches the
+            # same factory — the vetting machinery still runs in full).
+            est_wall = n_requests / args.rate_rps
+            plan_th = run_fleet_plan(
+                router,
+                [
+                    FleetAction(
+                        at_s=0.25 * est_wall
+                        + i * max(0.5, 0.4 * est_wall / args.replicas),
+                        kind="upgrade", replica=i,
+                    )
+                    for i in range(args.replicas)
+                ],
+            )
         report = run_engine_loop(router, spec)
         if plan_th is not None:
             plan_th.join(timeout=60.0)
@@ -988,7 +1052,13 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             "ejects": counters.get("ejects", 0),
             "brownout_shed": counters.get("brownout_shed", 0),
             "errors": counters.get("errors", 0),
+            "relaunches": counters.get("relaunches", 0),
+            "upgrades": counters.get("upgrades", 0),
+            "upgrades_refused": counters.get("upgrades_refused", 0),
         },
+        "replica_mode": (
+            "process" if args.fleet_scenario == "proc-kill" else "inproc"
+        ),
         "per_replica_submits": per_replica,
         "lost_requests": lost,
         "ttft_p50_s": round(s["ttft"]["p50"], 4),

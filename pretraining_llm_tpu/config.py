@@ -862,8 +862,14 @@ class FrontendConfig:
     capacity_ring: int = 512
     # ---- fleet (frontend/router.py); replicas=1 keeps the single
     # EngineLoop path with zero router overhead. -----------------------
-    # Number of in-process engine replicas behind the router tier.
+    # Number of engine replicas behind the router tier.
     replicas: int = 1
+    # Where each replica's engine lives: "inproc" (an EngineLoop thread
+    # in the gateway process) or "process" (one worker subprocess per
+    # replica — frontend/worker.py — behind a socket, so a kill -9 or a
+    # dropped connection is a REAL fault domain, not a simulated one).
+    # The router/sentinel/gateway contract is identical in both modes.
+    replica_mode: str = "inproc"
     # Prefix-affinity routing: prompt tokens hashed for placement. 0
     # disables affinity (pure least-loaded).
     affinity_tokens: int = 32
@@ -877,8 +883,11 @@ class FrontendConfig:
     # Relaunch backoff for ejected replicas: initial and cap (doubles).
     eject_backoff_s: float = 0.5
     eject_backoff_max_s: float = 8.0
-    # Max failovers per request before it errors out.
-    redrive_max: int = 3
+    # Max failovers per request before it errors out (renamed from
+    # ``redrive_max`` — see MIGRATION.md): a request that kills every
+    # replica it lands on gets a clean terminal error after this many
+    # attempts instead of fueling a redrive storm.
+    redrive_max_attempts: int = 3
     # Brownout: when the healthy fraction of the fleet drops below this,
     # shed low-priority / long-deadline work with 429. 0 disables.
     brownout_min_healthy_frac: float = 0.0
@@ -971,9 +980,15 @@ class FrontendConfig:
                 "eject_backoff_max_s must be >= eject_backoff_s, got "
                 f"{self.eject_backoff_max_s} < {self.eject_backoff_s}"
             )
-        if self.redrive_max < 0:
+        if self.replica_mode not in ("inproc", "process"):
             raise ValueError(
-                f"redrive_max must be >= 0, got {self.redrive_max}"
+                f"replica_mode must be 'inproc' or 'process', got "
+                f"{self.replica_mode!r}"
+            )
+        if self.redrive_max_attempts < 0:
+            raise ValueError(
+                f"redrive_max_attempts must be >= 0, got "
+                f"{self.redrive_max_attempts}"
             )
         if not 0.0 <= self.brownout_min_healthy_frac <= 1.0:
             raise ValueError(

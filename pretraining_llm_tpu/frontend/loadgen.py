@@ -429,18 +429,32 @@ class FleetAction:
                wall-clock analogue of the injector's ``replica_crash@req_n``;
       drain    administrative drain: redrive in-flight work to survivors,
                stop the loop, hold the replica not-ready;
-      restore  relaunch a drained/ejected replica with a fresh engine.
+      restore  relaunch a drained/ejected replica with a fresh engine;
+      upgrade  probe-vetted weight upgrade: drain, apply ``update`` to the
+               replica's spec/factory, relaunch HELD, run golden probes,
+               and only then take traffic (Router.upgrade_replica). The
+               mid-upgrade-kill drill rides this action: an ``update``
+               carrying ``kill_after_submits: 1`` makes the new worker die
+               on its first vetting probe, which must roll the old weights
+               back without clients ever seeing the unvetted checkpoint.
     """
 
     at_s: float
-    kind: str  # "kill" | "drain" | "restore"
+    kind: str  # "kill" | "drain" | "restore" | "upgrade"
     replica: int
+    # Spec/factory delta applied before the upgrade relaunch (upgrade
+    # only). None means "relaunch with the current spec" — still vetted.
+    update: Optional[Dict[str, Any]] = None
 
     def __post_init__(self) -> None:
-        if self.kind not in ("kill", "drain", "restore"):
+        if self.kind not in ("kill", "drain", "restore", "upgrade"):
             raise ValueError(f"unknown fleet action kind {self.kind!r}")
         if self.at_s < 0:
             raise ValueError(f"at_s must be >= 0, got {self.at_s}")
+        if self.update is not None and self.kind != "upgrade":
+            raise ValueError(
+                f"update only applies to upgrade actions, got {self.kind!r}"
+            )
 
 
 def rolling_restart_plan(
@@ -468,6 +482,13 @@ def run_fleet_plan(router: Any, actions: List[FleetAction]) -> threading.Thread:
 
     def _kill(replica: int) -> None:
         rep = router.replicas[replica]
+        # Out-of-process replica: the honest kill is SIGKILL to the worker
+        # itself — the parent sees the socket die, exactly like a real
+        # process death.
+        proc = getattr(rep, "proc", None)
+        if proc is not None:
+            proc.kill()
+            return
         eng = rep.engine
         if eng is None:
             return
@@ -489,6 +510,8 @@ def run_fleet_plan(router: Any, actions: List[FleetAction]) -> threading.Thread:
                     _kill(act.replica)
                 elif act.kind == "drain":
                     router.drain(act.replica)
+                elif act.kind == "upgrade":
+                    router.upgrade_replica(act.replica, act.update)
                 else:
                     router.restore(act.replica)
             except Exception:

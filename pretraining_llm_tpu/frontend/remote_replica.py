@@ -1,0 +1,957 @@
+"""RemoteReplica: the parent-side client for an out-of-process worker.
+
+Duck-types the :class:`frontend.replica.Replica` surface the router
+consumes — ``state``/``generation``/``submits``/``accepting``/``alive``/
+``load()``/``submit()``/``drain()``/``eject()``/``relaunch()``/
+``stop()``/``on_state``/``registry``/``engine``/``loop`` — so
+``Router``, the integrity sentinel, and the gateway run UNCHANGED
+whether a replica is an object in this process or a worker process on
+the other end of a socket (``--replica_mode process``).
+
+The key trick is that submitted attempts are real
+:class:`frontend.engine_loop.FrontendRequest` objects: the reader
+thread feeds ``tokens``/``out_q`` exactly the way EngineLoop does, so
+the router's ``_pump``/abandonment/result machinery needs no remote
+special case.
+
+Fault domain (the robustness core of this tier):
+
+- every RPC has a per-call timeout; idempotent ops (health, metrics,
+  debug, drain, cancel) retry with seeded exponential backoff +
+  jitter; ``submit`` is never retried (an accepted-but-unacked submit
+  must surface as a failure, not a silent duplicate).
+- a send failure, reader EOF, or final RPC timeout declares the
+  connection lost: the replica stops reporting ``running``, every
+  live attempt gets an ``"engine failure: worker connection lost"``
+  error terminal (the redrivable prefix — the router immediately
+  redrives them bit-identically onto survivors), and the router's
+  health loop ejects + backs off + relaunches exactly as for an
+  in-process engine crash.
+- ``relaunch`` always tears the previous process down (graceful
+  ``shutdown`` RPC, then SIGKILL) before spawning — a crash-looping
+  worker can never accumulate orphans; the worker's own stdin-EOF
+  watcher covers the reverse direction (dead parent).
+
+The worker spec (see ``frontend/worker.py``) is stored on the replica;
+``update_snapshot()``/``apply_update({...})`` snapshot and mutate it,
+which is how ``Router.upgrade_replica`` swaps a checkpoint path and —
+on a failed probe vetting — restores the old one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..observability.metrics import MetricsRegistry
+from .admission import RejectedBusy
+from .engine_loop import _TRACE_UNSET, FrontendRequest
+from .replica import REPLICA_STATES, ReplicaUnavailable
+from .wire import ConnectionLost, recv_frame, send_frame
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Transport latency buckets: LAN-ish RPCs, 1ms..5s.
+_RPC_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 5.0)
+
+
+class _RemoteEngine:
+    """Engine facade built from the worker's hello constants. Exposes
+    exactly what the router needs from ``rep.engine``: submit-time
+    validation (mirroring ``ServingEngine.validate_request`` so process
+    mode returns the same HTTP 400s), the probe-geometry constants, and
+    ``build_probe_set`` delegating to the worker (which holds the
+    params this process never sees)."""
+
+    def __init__(self, rep: "RemoteReplica", hello: Dict[str, Any]) -> None:
+        self._rep = rep
+        self.temperature = float(hello["temperature"])
+        self.block_size = int(hello["block_size"])
+        self.max_seq = int(hello["max_seq"])
+        self.max_batch = int(hello["max_batch"])
+        self.n_blocks = int(hello["n_blocks"])
+        self.cfg = SimpleNamespace(
+            vocab_size=int(hello["vocab_size"]),
+            context_length=int(hello["context_length"]),
+        )
+        self.params = None        # weights live in the worker
+        self.prefix_cache = None  # router's cached-token peek: no local view
+
+    def validate_request(self, prompt_ids: Any, max_new_tokens: Any) -> int:
+        from ..generation import paged
+
+        try:
+            max_new = int(max_new_tokens)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"max_new_tokens must be an integer, got "
+                f"{type(max_new_tokens).__name__}"
+            )
+        if max_new != max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens must be an integer, got {max_new_tokens!r}"
+            )
+        p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt")
+        ids = np.asarray(prompt_ids)
+        if ids.ndim != 1:
+            raise ValueError(
+                f"prompt must be a flat list of token ids, got an array of "
+                f"shape {ids.shape}"
+            )
+        if ids.dtype.kind not in "iu":
+            raise ValueError(
+                f"prompt must be integer token ids, got dtype {ids.dtype}"
+            )
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= self.cfg.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.cfg.vocab_size}); "
+                f"got range [{lo}, {hi}]"
+            )
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        total = p + max_new
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt({p}) + max_new({max_new}) = {total} exceeds "
+                f"max_seq={self.max_seq}"
+            )
+        if paged.required_blocks(total, self.block_size) > self.n_blocks - 1:
+            raise ValueError(
+                f"request needs "
+                f"{paged.required_blocks(total, self.block_size)} "
+                f"blocks; the pool only has {self.n_blocks - 1}"
+            )
+        return max_new
+
+    def build_probe_set(
+        self, *, n_probes: int = 2, probe_len: int = 9, max_new: int = 4
+    ) -> List[Any]:
+        from ..resilience.integrity import GoldenProbe
+
+        raw = self._rep._rpc(
+            "probe_set",
+            {"n_probes": n_probes, "probe_len": probe_len, "max_new": max_new},
+            timeout=self._rep.spawn_timeout_s,
+        )
+        return [
+            GoldenProbe(
+                prompt=tuple(int(t) for t in d["prompt"]),
+                expected=tuple(int(t) for t in d["expected"]),
+            )
+            for d in raw
+        ]
+
+
+class _RemoteLoop:
+    """EngineLoop facade over the health snapshot + RPCs. Identity is
+    stable across worker relaunches (mirroring how the router treats
+    ``rep.loop`` as replaced-on-relaunch is unnecessary: the router
+    only reads liveness properties and calls submit/cancel, all of
+    which route to whatever connection is current)."""
+
+    def __init__(self, rep: "RemoteReplica") -> None:
+        self._rep = rep
+
+    # -- liveness mirror ---------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._rep._connected() and bool(
+            self._rep._snapshot.get("running", False)
+        )
+
+    @property
+    def draining(self) -> bool:
+        return bool(self._rep._snapshot.get("draining", False))
+
+    @property
+    def active_requests(self) -> int:
+        return max(
+            len(self._rep._attempts),
+            int(self._rep._snapshot.get("active_requests", 0)),
+        )
+
+    @property
+    def failure(self) -> Optional[str]:
+        return self._rep._snapshot.get("failure")
+
+    @property
+    def weight_fingerprint0(self) -> Optional[str]:
+        return self._rep._snapshot.get("weight_fingerprint0")
+
+    @property
+    def weight_fingerprint(self) -> Optional[str]:
+        return self._rep._snapshot.get("weight_fingerprint")
+
+    def last_turn_age_s(self) -> float:
+        snap = self._rep._snapshot
+        age = float(snap.get("last_turn_age_s", 0.0))
+        taken = snap.get("t")
+        if taken is not None:
+            age += max(0.0, self._rep._clock() - taken)
+        return age
+
+    # -- request path ------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline_s: Optional[float] = None,
+        trace: Any = _TRACE_UNSET,
+        priority: int = 0,
+    ) -> FrontendRequest:
+        if not self.running:
+            raise RuntimeError("EngineLoop is not running")
+        return self._rep._wire_submit(
+            prompt,
+            max_new_tokens,
+            deadline_s=deadline_s,
+            priority=priority,
+            lane="loop",
+            trace=trace,
+        )
+
+    def cancel(self, req: FrontendRequest) -> None:
+        try:
+            self._rep._rpc("cancel", {"rid": req.rid}, retries=0)
+        except Exception:
+            pass  # a dead worker has already cancelled everything
+
+    def begin_drain(self) -> None:
+        self._rep._snapshot["draining"] = True
+        try:
+            self._rep._rpc("drain")
+        except Exception:
+            pass
+
+    # -- observability passthrough -----------------------------------
+
+    def metrics(self) -> Dict[str, Any]:
+        try:
+            return dict(self._rep._rpc("metrics"))
+        except Exception:
+            return {}
+
+    def debug_requests(self) -> List[Dict[str, Any]]:
+        try:
+            return list(self._rep._rpc("debug_requests"))
+        except Exception:
+            return []
+
+    def debug_engine(self) -> Dict[str, Any]:
+        try:
+            return dict(self._rep._rpc("debug_engine"))
+        except Exception:
+            return {}
+
+    def readiness(self) -> Dict[str, Any]:
+        return {
+            "ready": self.running and not self.draining,
+            "running": self.running,
+            "draining": self.draining,
+        }
+
+
+class RemoteReplica:
+    """One worker process + socket, presented as a Replica."""
+
+    def __init__(
+        self,
+        index: int,
+        spec: Dict[str, Any],
+        *,
+        bus: Any = None,
+        registry_prefix: str = "pllm_serving_",
+        registry_labels: Optional[Dict[str, Any]] = None,
+        fault_injector: Any = None,
+        clock: Any = time.monotonic,
+        rpc_timeout_s: float = 30.0,
+        rpc_retries: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_jitter_frac: float = 0.25,
+        backoff_seed: int = 0,
+        spawn_timeout_s: float = 600.0,
+        health_interval_s: float = 0.05,
+        python: str = sys.executable,
+    ) -> None:
+        self.index = int(index)
+        self.spec = dict(spec)
+        self._bus = bus
+        self.faults = fault_injector
+        self._clock = clock
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.rpc_retries = int(rpc_retries)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_jitter_frac = float(backoff_jitter_frac)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.health_interval_s = float(health_interval_s)
+        self._python = python
+
+        self.registry = MetricsRegistry(
+            registry_prefix,
+            const_labels={**(registry_labels or {}), "replica": self.index},
+        )
+        self._c_spawns = self.registry.counter(
+            "worker_spawns_total", "worker processes launched"
+        )
+        self._c_retries = self.registry.counter(
+            "worker_rpc_retries_total", "worker RPCs retried after timeout"
+        )
+        self._c_timeouts = self.registry.counter(
+            "worker_rpc_timeouts_total", "worker RPC attempts that timed out"
+        )
+        self._h_rpc = self.registry.histogram(
+            "worker_rpc_latency_seconds",
+            "round-trip latency of worker RPC replies",
+            buckets=_RPC_BUCKETS,
+        )
+
+        self.state = "ejected"
+        self.generation = 0
+        self.submits = 0
+        self.on_state: Any = None
+        self._lock = threading.Lock()
+
+        # Connection plumbing. _conn_gen increments per successful
+        # connect; _on_conn_lost is idempotent per generation.
+        self._conn_lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._conn_gen = 0
+        self._rpc_seq = 0
+        self._pending: Dict[int, "queue.Queue"] = {}
+        self._pending_lock = threading.Lock()
+        self._attempts: Dict[int, FrontendRequest] = {}
+        self._attempts_lock = threading.Lock()
+        self._snapshot: Dict[str, Any] = {"running": False}
+        self._rng = random.Random(backoff_seed * 1000003 + self.index)
+        self._rng_lock = threading.Lock()
+        self._health_stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+        self.engine: Optional[_RemoteEngine] = None
+        # None until first launch so Router.start()'s `rep.loop is None`
+        # launch guard works unchanged; stable _RemoteLoop afterwards.
+        self.loop: Optional[_RemoteLoop] = None
+
+    # -- spec management (rolling upgrades) ---------------------------
+
+    def update_snapshot(self) -> Dict[str, Any]:
+        """Copy of the current worker spec — hold this to roll back."""
+        with self._lock:
+            return json.loads(json.dumps(self.spec))
+
+    def apply_update(
+        self, update: Optional[Dict[str, Any]], *, replace: bool = False
+    ) -> None:
+        """Patch (merge) worker-spec fields, e.g. ``{"model_path":
+        "..."}`` for a checkpoint upgrade; takes effect at the next
+        (re)launch. ``replace=True`` swaps the whole spec — the rollback
+        path, so keys the refused upgrade ADDED don't survive the
+        restore. ``None`` means relaunch-as-is."""
+        if update is None:
+            return
+        with self._lock:
+            if replace:
+                self.spec = dict(update)
+            else:
+                self.spec.update(update)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "RemoteReplica":
+        with self._lock:
+            self._launch_locked("start")
+        return self
+
+    def relaunch(
+        self, *, stop_timeout: float = 1.0, hold: bool = False
+    ) -> "RemoteReplica":
+        with self._lock:
+            self._teardown_locked(stop_timeout)
+            self._launch_locked("relaunch", hold=hold)
+        return self
+
+    def activate(self, reason: str = "activate") -> None:
+        """Promote a held (vetting) replica to traffic-eligible."""
+        with self._lock:
+            self._set_state("active", reason)
+
+    def drain(self) -> None:
+        with self._lock:
+            if self.loop is not None:
+                self.loop.begin_drain()
+            self._set_state("draining", "drain")
+
+    def eject(self, reason: str) -> None:
+        with self._lock:
+            self._set_state("ejected", reason)
+
+    def stop(self, timeout: float = 5.0) -> bool:
+        with self._lock:
+            return self._teardown_locked(timeout)
+
+    def _launch_locked(self, reason: str, hold: bool = False) -> None:
+        spec = {**self.spec, "index": self.index}
+        cmd = [
+            self._python,
+            "-m",
+            "pretraining_llm_tpu.frontend.worker",
+            "--spec-json",
+            json.dumps(spec),
+        ]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        proc = subprocess.Popen(
+            cmd,
+            stdin=subprocess.PIPE,   # orphan-detection pipe; never written
+            stdout=subprocess.PIPE,  # handshake line
+            stderr=None,
+            env=env,
+        )
+        try:
+            hs = self._read_handshake(proc)
+            sock = socket.create_connection(
+                ("127.0.0.1", int(hs["port"])), timeout=10.0
+            )
+        except Exception:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            raise
+        sock.settimeout(None)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        with self._conn_lock:
+            self._proc = proc
+            self._sock = sock
+            self._conn_gen += 1
+            gen = self._conn_gen
+        threading.Thread(
+            target=self._reader,
+            args=(sock, gen),
+            name=f"remote-replica-{self.index}-reader",
+            daemon=True,
+        ).start()
+        # hello blocks until the worker's engine is built (the connect
+        # itself only landed in the listen backlog) — so its timeout is
+        # the engine-build budget, not the RPC budget.
+        hello = self._rpc("hello", timeout=self.spawn_timeout_s, retries=0)
+        self.engine = _RemoteEngine(self, hello)
+        if self.loop is None:
+            self.loop = _RemoteLoop(self)
+        self._snapshot = {
+            "running": True,
+            "draining": False,  # a HELD launch still accepts loop submits
+            "active_requests": 0,
+            "last_turn_age_s": 0.0,
+            "t": self._clock(),
+        }
+        self.generation += 1
+        self._c_spawns.inc()
+        self._emit(
+            "worker_spawn",
+            replica=self.index,
+            pid=int(hs["pid"]),
+            port=int(hs["port"]),
+            reason=reason,
+            generation=self.generation,
+            held=bool(hold),
+        )
+        self._ensure_health_thread()
+        # A held launch parks in "draining": the loop accepts submits
+        # (begin_drain was NOT sent), but the router will not route
+        # traffic to it and the health loop ignores it — the vetting
+        # window for rolling upgrades.
+        self._set_state("draining" if hold else "active", reason)
+
+    def _read_handshake(self, proc: subprocess.Popen) -> Dict[str, Any]:
+        result: Dict[str, Any] = {}
+
+        def _read() -> None:
+            while True:
+                line = proc.stdout.readline()
+                if not line:
+                    return
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and "worker" in obj:
+                    result.update(obj["worker"])
+                    return
+
+        t = threading.Thread(target=_read, daemon=True)
+        t.start()
+        t.join(self.spawn_timeout_s)
+        if "port" not in result:
+            raise RuntimeError(
+                f"worker {self.index} did not announce a port within "
+                f"{self.spawn_timeout_s}s (exit code "
+                f"{proc.poll()})"
+            )
+        return result
+
+    def _teardown_locked(self, timeout: float) -> bool:
+        clean = True
+        proc = self._proc
+        if self._connected():
+            try:
+                self._rpc("shutdown", timeout=min(2.0, timeout), retries=0)
+            except Exception:
+                clean = False
+        if proc is not None:
+            try:
+                proc.wait(timeout=max(0.1, timeout))
+            except subprocess.TimeoutExpired:
+                clean = False
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+                except OSError:
+                    pass
+            # A worker that died on its own (SIGKILL, crash) before we
+            # tore it down waits instantly — the exit code is the truth.
+            if proc.returncode != 0:
+                clean = False
+            self._emit(
+                "worker_exit",
+                replica=self.index,
+                pid=proc.pid,
+                clean=clean,
+                returncode=proc.returncode,
+            )
+        with self._conn_lock:
+            sock, self._sock = self._sock, None
+            self._proc = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._snapshot = {"running": False}
+        self._fail_pending("worker stopped")
+        self._fail_attempts("shutdown: worker stopped")
+        return clean
+
+    # -- connection fault domain --------------------------------------
+
+    def _connected(self) -> bool:
+        return self._sock is not None
+
+    def _reader(self, sock: socket.socket, gen: int) -> None:
+        try:
+            while True:
+                self._handle_frame(recv_frame(sock))
+        except (ConnectionLost, Exception) as e:
+            self._on_conn_lost(gen, str(e) or type(e).__name__)
+
+    def _handle_frame(self, frame: Dict[str, Any]) -> None:
+        if "id" in frame:
+            with self._pending_lock:
+                q = self._pending.get(frame["id"])
+            if q is not None:
+                q.put(frame)
+            return
+        if "token" in frame:
+            with self._attempts_lock:
+                attempt = self._attempts.get(frame["token"])
+            if attempt is not None:
+                tok = int(frame["t"])
+                attempt.tokens.append(tok)
+                attempt.out_q.put(("token", tok))
+            return
+        if "end" in frame:
+            with self._attempts_lock:
+                attempt = self._attempts.pop(frame["end"], None)
+            if attempt is not None:
+                attempt.status = str(frame.get("status", "error"))
+                attempt.info.update(frame.get("info") or {})
+                self._finish_trace(attempt)
+                attempt.out_q.put(
+                    ("end", attempt.status, dict(attempt.info))
+                )
+            return
+        if frame.get("op") == "event" and self._bus is not None:
+            try:
+                self._bus.emit(
+                    str(frame.get("kind", "")),
+                    step=frame.get("step"),
+                    **dict(frame.get("fields") or {}),
+                )
+            except Exception:
+                pass
+
+    @staticmethod
+    def _finish_trace(attempt: FrontendRequest) -> None:
+        trace = attempt.trace
+        if trace is None:
+            return
+        try:
+            if not getattr(trace, "finished", True):
+                trace.finish(attempt.status)
+        except Exception:
+            pass
+
+    def _on_conn_lost(self, gen: int, reason: str) -> None:
+        with self._conn_lock:
+            if gen != self._conn_gen or self._sock is None:
+                return  # stale reader, or teardown already ran
+            sock, self._sock = self._sock, None
+        try:
+            sock.close()
+        except OSError:
+            pass
+        self._snapshot = {"running": False, "failure": reason}
+        self._fail_pending(reason)
+        self._fail_attempts(f"engine failure: worker connection lost ({reason})")
+        self._emit("worker_conn_lost", replica=self.index, reason=reason)
+
+    def _fail_pending(self, reason: str) -> None:
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for rid, q in pending.items():
+            q.put({"id": rid, "error": "conn_lost", "message": reason})
+
+    def _fail_attempts(self, reason: str) -> None:
+        """Terminal every live attempt the way EngineLoop.stop fails its
+        requests — ``engine failure`` reasons are what the router's
+        pump recognizes as redrivable."""
+        with self._attempts_lock:
+            attempts, self._attempts = self._attempts, {}
+        for attempt in attempts.values():
+            attempt.status = "error"
+            attempt.info.setdefault("reason", reason)
+            self._finish_trace(attempt)
+            attempt.out_q.put(("end", "error", dict(attempt.info)))
+
+    # -- RPC ----------------------------------------------------------
+
+    def _backoff_s(self, attempt_k: int) -> float:
+        with self._rng_lock:
+            u = self._rng.random()
+        return (
+            self._backoff_base_s
+            * (2.0 ** (attempt_k - 1))
+            * (1.0 + self._backoff_jitter_frac * u)
+        )
+
+    def _rpc(
+        self,
+        op: str,
+        payload: Optional[Dict[str, Any]] = None,
+        *,
+        timeout: Optional[float] = None,
+        retries: Optional[int] = None,
+    ) -> Any:
+        timeout = self.rpc_timeout_s if timeout is None else timeout
+        retries = self.rpc_retries if retries is None else retries
+        for k in range(retries + 1):
+            if k:
+                self._c_retries.inc()
+                self._emit(
+                    "rpc_retry", replica=self.index, op=op, attempt=k
+                )
+                time.sleep(self._backoff_s(k))
+            with self._conn_lock:
+                sock, gen = self._sock, self._conn_gen
+            if sock is None:
+                raise ReplicaUnavailable(
+                    f"replica {self.index} worker not connected"
+                )
+            with self._pending_lock:
+                self._rpc_seq += 1
+                rid = self._rpc_seq
+                q: "queue.Queue" = queue.Queue()
+                self._pending[rid] = q
+            frame = {"op": op, "id": rid, **(payload or {})}
+            t0 = time.monotonic()
+            try:
+                with self._wlock:
+                    send_frame(sock, frame)
+                reply = q.get(timeout=timeout)
+            except ConnectionLost as e:
+                self._on_conn_lost(gen, f"send failed during {op}: {e}")
+                raise ReplicaUnavailable(
+                    f"replica {self.index} worker connection lost "
+                    f"during {op}: {e}"
+                ) from e
+            except queue.Empty:
+                self._c_timeouts.inc()
+                if k >= retries:
+                    self._on_conn_lost(
+                        gen, f"rpc {op} timed out after {timeout}s"
+                    )
+                    raise ReplicaUnavailable(
+                        f"replica {self.index} rpc {op} timed out "
+                        f"after {timeout}s"
+                    )
+                continue
+            finally:
+                with self._pending_lock:
+                    self._pending.pop(rid, None)
+            self._h_rpc.observe(time.monotonic() - t0)
+            if "ok" in reply:
+                return reply["ok"]
+            kind = reply.get("error", "runtime")
+            message = str(reply.get("message", kind))
+            if kind == "conn_lost":
+                raise ReplicaUnavailable(
+                    f"replica {self.index} worker connection lost "
+                    f"during {op}: {message}"
+                )
+            raise _RPC_ERRORS.get(kind, _raise_runtime)(reply, message)
+        raise AssertionError("unreachable")
+
+    # -- the Replica surface ------------------------------------------
+
+    @property
+    def proc(self) -> Optional[subprocess.Popen]:
+        """The live worker process, if any (fleet drills SIGKILL it)."""
+        return self._proc
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == "active" and self.loop is not None and (
+            self.loop.running
+        )
+
+    @property
+    def alive(self) -> bool:
+        return self.loop is not None and self.loop.running
+
+    def load(self) -> int:
+        return len(self._attempts)
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline_s: Optional[float] = None,
+        trace: Any = _TRACE_UNSET,
+        priority: int = 0,
+    ) -> FrontendRequest:
+        with self._lock:
+            if not self.accepting:
+                raise ReplicaUnavailable(
+                    f"replica {self.index} is {self.state}"
+                )
+            if self.faults is not None and self.faults.should_reject(
+                self.index
+            ):
+                raise RejectedBusy(
+                    f"replica {self.index} refusing (injected reject_storm)",
+                    0.05,
+                )
+        attempt = self._wire_submit(
+            prompt,
+            max_new_tokens,
+            deadline_s=deadline_s,
+            priority=priority,
+            lane="replica",
+            trace=trace,
+        )
+        with self._lock:
+            self.submits += 1
+            nth = self.submits
+        if self.faults is not None:
+            self.faults.on_submit(self.index, nth)
+            self._execute_process_faults()
+        return attempt
+
+    def _wire_submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline_s: Optional[float],
+        priority: int,
+        lane: str,
+        trace: Any = _TRACE_UNSET,
+    ) -> FrontendRequest:
+        prompt_ids = [int(t) for t in prompt]
+        now = time.monotonic()
+        attempt = FrontendRequest(
+            prompt=prompt_ids,
+            max_new=int(max_new_tokens),
+            deadline=(now + deadline_s) if deadline_s else None,
+            submitted_s=now,
+        )
+        if trace is not _TRACE_UNSET:
+            attempt.trace = trace
+        attempt.priority = int(priority)
+        with self._pending_lock:
+            self._rpc_seq += 1
+            wrid = self._rpc_seq
+        attempt.rid = wrid
+        # Register BEFORE sending: the worker may stream the first
+        # token before the submit reply is even processed here.
+        with self._attempts_lock:
+            self._attempts[wrid] = attempt
+        try:
+            self._rpc(
+                "submit",
+                {
+                    "rid": wrid,
+                    "prompt": prompt_ids,
+                    "max_new": int(max_new_tokens),
+                    "deadline_s": deadline_s,
+                    "priority": int(priority),
+                    "lane": lane,
+                },
+                retries=0,  # NEVER retried: ambiguous submits must fail
+            )
+        except Exception:
+            with self._attempts_lock:
+                self._attempts.pop(wrid, None)
+            raise
+        return attempt
+
+    def _execute_process_faults(self) -> None:
+        take = getattr(self.faults, "take_process_faults", None)
+        if take is None:
+            return
+        for kind in take(self.index):
+            self._emit("fault_fired", fault=kind, replica=self.index)
+            if kind == "worker_kill":
+                proc = self._proc
+                if proc is not None:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+            elif kind == "worker_stall":
+                with self._conn_lock:
+                    sock = self._sock
+                if sock is not None:
+                    try:
+                        with self._wlock:
+                            send_frame(sock, {"op": "stall"})
+                    except ConnectionLost:
+                        pass
+            elif kind == "conn_drop":
+                with self._conn_lock:
+                    sock = self._sock
+                if sock is not None:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "replica": self.index,
+            "state": self.state,
+            "generation": self.generation,
+            "submits": self.submits,
+            "alive": self.alive,
+            "mode": "process",
+            "pid": self._proc.pid if self._proc is not None else None,
+        }
+        loop = self.loop
+        if loop is not None:
+            out["draining"] = loop.draining
+            out["last_turn_age_s"] = round(loop.last_turn_age_s(), 3)
+            out["active_requests"] = loop.active_requests
+            if loop.failure is not None:
+                out["failure"] = loop.failure
+        return out
+
+    # -- internals ----------------------------------------------------
+
+    def _ensure_health_thread(self) -> None:
+        if self._health_thread is not None and self._health_thread.is_alive():
+            return
+        self._health_stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_poll,
+            name=f"remote-replica-{self.index}-health",
+            daemon=True,
+        )
+        self._health_thread.start()
+
+    def _health_poll(self) -> None:
+        stop = self._health_stop
+        while not stop.wait(self.health_interval_s):
+            if not self._connected():
+                continue
+            try:
+                snap = self._rpc("health", timeout=self.rpc_timeout_s)
+            except Exception:
+                continue  # conn-lost path already updated the snapshot
+            snap["t"] = self._clock()
+            self._snapshot = snap
+
+    def _set_state(self, state: str, reason: str) -> None:
+        assert state in REPLICA_STATES, state
+        self.state = state
+        self._emit(
+            "replica_state",
+            replica=self.index,
+            state=state,
+            reason=reason,
+            generation=self.generation,
+        )
+        hook = self.on_state
+        if hook is not None:
+            hook(self, state, reason)
+
+    def _emit(self, kind: str, **fields: Any) -> None:
+        if self._bus is None:
+            return
+        try:
+            self._bus.emit(kind, **fields)
+        except Exception:
+            pass
+
+
+def _raise_runtime(reply: Dict[str, Any], message: str) -> Exception:
+    return ReplicaUnavailable(message)
+
+
+def _raise_invalid(reply: Dict[str, Any], message: str) -> Exception:
+    return ValueError(message)
+
+
+def _raise_busy(reply: Dict[str, Any], message: str) -> Exception:
+    return RejectedBusy(message, float(reply.get("retry_after_s", 1.0)))
+
+
+def _raise_infeasible(reply: Dict[str, Any], message: str) -> Exception:
+    from .admission import RejectedInfeasible
+
+    return RejectedInfeasible(message, float(reply.get("estimate_s", 0.0)))
+
+
+_RPC_ERRORS = {
+    "invalid": _raise_invalid,
+    "busy": _raise_busy,
+    "infeasible": _raise_infeasible,
+    "unavailable": _raise_runtime,
+    "runtime": _raise_runtime,
+}

@@ -138,10 +138,18 @@ class Replica:
             self._launch_locked("start")
         return self
 
-    def relaunch(self, *, stop_timeout: float = 1.0) -> "Replica":
+    def relaunch(
+        self, *, stop_timeout: float = 1.0, hold: bool = False
+    ) -> "Replica":
         """Replace a dead/wedged/drained engine with a fresh one. The old
         loop is stopped best-effort (a wedged thread is abandoned — it is
-        a daemon and EngineLoop.stop already failed its requests)."""
+        a daemon and EngineLoop.stop already failed its requests).
+
+        ``hold=True`` parks the fresh engine in "draining" WITHOUT
+        draining the loop: it accepts direct ``loop.submit`` work (the
+        probe-vetting lane) but the router will not route traffic to it
+        and the health loop leaves it alone — the rolling-upgrade
+        vetting window. ``activate()`` promotes it."""
         with self._lock:
             old = self.loop
             if old is not None:
@@ -149,10 +157,35 @@ class Replica:
                     old.stop(timeout=stop_timeout)
                 except Exception:
                     pass
-            self._launch_locked("relaunch")
+            self._launch_locked("relaunch", hold=hold)
         return self
 
-    def _launch_locked(self, reason: str) -> None:
+    def activate(self, reason: str = "activate") -> None:
+        """Promote a held (vetting) replica to traffic-eligible."""
+        with self._lock:
+            self._set_state("active", reason)
+
+    # -- live weight upgrades ------------------------------------------------
+
+    def update_snapshot(self) -> Callable[[], Any]:
+        """The current engine factory — hold this to roll an upgrade
+        back (the process-mode twin snapshots the worker spec)."""
+        return self._engine_factory
+
+    def apply_update(
+        self, update: Optional[Callable[[], Any]], *, replace: bool = False
+    ) -> None:
+        """Swap the engine factory (e.g. one closing over a new
+        checkpoint's params); takes effect at the next (re)launch.
+        ``None`` means relaunch-as-is. A factory is already a complete
+        replacement, so ``replace`` (which process-mode spec patches
+        need for rollback) changes nothing here."""
+        if update is None:
+            return
+        with self._lock:
+            self._engine_factory = update
+
+    def _launch_locked(self, reason: str, hold: bool = False) -> None:
         engine = self._engine_factory()
         if self.faults is not None:
             engine.pipeline_tick = self.faults.wrap_tick(
@@ -181,7 +214,7 @@ class Replica:
         )
         self.loop.start()
         self.generation += 1
-        self._set_state("active", reason)
+        self._set_state("draining" if hold else "active", reason)
 
     def drain(self) -> None:
         """Refuse new work; in-flight requests keep decoding (the router

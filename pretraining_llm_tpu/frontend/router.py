@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import hashlib
 import queue
+import random
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
@@ -170,6 +171,8 @@ class Router:
         wedged_after_s: float = 0.0,
         eject_backoff_s: float = 0.5,
         eject_backoff_max_s: float = 8.0,
+        backoff_jitter_frac: float = 0.25,
+        backoff_seed: int = 0,
         redrive_max: int = 3,
         health_interval_s: float = 0.02,
         brownout_min_healthy_frac: float = 0.0,
@@ -221,6 +224,15 @@ class Router:
         self.wedged_after_s = float(wedged_after_s)
         self.eject_backoff_s = float(eject_backoff_s)
         self.eject_backoff_max_s = float(eject_backoff_max_s)
+        if not 0.0 <= backoff_jitter_frac <= 1.0:
+            raise ValueError(
+                f"backoff_jitter_frac must be in [0, 1], got "
+                f"{backoff_jitter_frac}"
+            )
+        self.backoff_jitter_frac = float(backoff_jitter_frac)
+        # Seeded: a crash-looping FLEET must not relaunch in lockstep
+        # (decorrelated thundering herds), yet drills stay reproducible.
+        self._backoff_rng = random.Random(backoff_seed)
         self.redrive_max = int(redrive_max)
         self.health_interval_s = float(health_interval_s)
         self.brownout_min_healthy_frac = float(brownout_min_healthy_frac)
@@ -249,6 +261,7 @@ class Router:
         self._live_lock = threading.Lock()
         self._next_frid = 0
         self._stopping = False
+        self._draining = False
         self._started = clock()
         self._stop_ev = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
@@ -260,16 +273,26 @@ class Router:
             "submitted": 0, "completed": 0, "cancelled": 0, "expired": 0,
             "errors": 0, "redrives": 0, "brownout_shed": 0, "ejects": 0,
             "probes": 0, "probe_failures": 0, "quarantines": 0,
+            "relaunches": 0, "upgrades": 0, "upgrades_refused": 0,
         }
         self._g_state: Dict[int, Any] = {}
+        self._g_backoff: Dict[int, Any] = {}
         self._c_redrives = self._c_shed = self._c_ejects = None
         self._c_probes = self._c_probe_fail = self._c_quarantines = None
+        self._c_relaunches = None
         self._g_brownout = None
         if registry is not None:
             for rep in self.replicas:
                 self._g_state[rep.index] = registry.gauge(
                     "replica_state",
                     "replica lifecycle (0=ejected, 1=active, 2=draining)",
+                    replica=rep.index,
+                )
+                self._g_backoff[rep.index] = registry.gauge(
+                    "replica_backoff_s",
+                    "currently scheduled relaunch backoff (0 = not backing "
+                    "off) — a crash-looping replica shows as a climb to "
+                    "the cap instead of silent retries",
                     replica=rep.index,
                 )
             self._c_redrives = registry.counter(
@@ -281,6 +304,9 @@ class Router:
             self._c_ejects = registry.counter(
                 "replica_ejects_total",
                 "replicas declared dead/wedged by the health loop")
+            self._c_relaunches = registry.counter(
+                "replica_relaunch_total",
+                "replica engines (re)launched after eject/drain/upgrade")
             self._g_brownout = registry.gauge(
                 "brownout_active", "1 while the fleet is in brownout")
             self._c_probes = registry.counter(
@@ -342,12 +368,23 @@ class Router:
                     f"room for a probe with probe_max_new="
                     f"{self.probe_max_new}"
                 )
-            self._probe_set = build_probe_set(
-                engine.params, engine.cfg,
-                n_probes=self.probe_count,
-                probe_len=probe_len,
-                max_new=self.probe_max_new,
-            )
+            # Process-mode replicas expose a build_probe_set facade (the
+            # params live in the worker); in-process engines fall through
+            # to the local reference path.
+            builder = getattr(engine, "build_probe_set", None)
+            if builder is not None:
+                self._probe_set = builder(
+                    n_probes=self.probe_count,
+                    probe_len=probe_len,
+                    max_new=self.probe_max_new,
+                )
+            else:
+                self._probe_set = build_probe_set(
+                    engine.params, engine.cfg,
+                    n_probes=self.probe_count,
+                    probe_len=probe_len,
+                    max_new=self.probe_max_new,
+                )
             # Re-pin the expected tokens from the SERVING path itself. The
             # reference generate above vets the prompts, but at bf16 its
             # argmax near-ties can legitimately differ from the paged
@@ -414,6 +451,8 @@ class Router:
         is unchanged."""
         if self._stopping:
             raise RuntimeError("Router is stopped")
+        if self._draining:
+            raise RuntimeError("Router is draining")
         if trace is _TRACE_UNSET:
             trace = (
                 self.tracer.begin_request() if self.tracer is not None else None
@@ -647,13 +686,21 @@ class Router:
                     and self._redrivable(info)
                     and not rreq.cancel_requested
                     and not self._stopping
-                    and rreq.redrives < self.redrive_max
                 ):
-                    if self._redrive_locked(
-                        rreq, rep_index,
-                        str(info.get("reason", "replica failure")),
-                    ):
-                        return
+                    reason = str(info.get("reason", "replica failure"))
+                    if rreq.redrives < self.redrive_max:
+                        if self._redrive_locked(rreq, rep_index, reason):
+                            return
+                    else:
+                        # Attempt cap hit: the REQUEST is the poison (it
+                        # has killed every replica it landed on). A clean
+                        # terminal stops the redrive storm; the fleet
+                        # recovers replica-by-replica behind it.
+                        info = {
+                            "reason": (
+                                f"redrive budget exhausted after {reason}"
+                            )
+                        }
                 self._finish_locked(rreq, status, info)
             return
 
@@ -784,6 +831,7 @@ class Router:
                         self._relaunch_at.pop(rep.index, None)
                         try:
                             rep.relaunch(stop_timeout=0.5)
+                            self._count_relaunch(rep.index)
                         except Exception:
                             backoff = self._next_backoff(rep.index)
                             self._relaunch_at[rep.index] = (
@@ -795,7 +843,20 @@ class Router:
     def _next_backoff(self, index: int) -> float:
         cur = self._backoff.get(index, self.eject_backoff_s)
         self._backoff[index] = min(cur * 2.0, self.eject_backoff_max_s)
+        cur *= 1.0 + self.backoff_jitter_frac * self._backoff_rng.random()
+        gauge = self._g_backoff.get(index)
+        if gauge is not None:
+            gauge.set(cur)
         return cur
+
+    def _count_relaunch(self, index: int) -> None:
+        with self._counters_lock:
+            self.counters["relaunches"] += 1
+        if self._c_relaunches is not None:
+            self._c_relaunches.inc()
+        gauge = self._g_backoff.get(index)
+        if gauge is not None:
+            gauge.set(0.0)
 
     def _eject(self, rep: Replica, reason: str) -> None:
         rep.eject(reason)
@@ -1008,8 +1069,157 @@ class Router:
         second half of a rolling restart) and reset its backoff."""
         rep = self.replicas[index]
         rep.relaunch()
+        self._count_relaunch(index)
         self._backoff.pop(index, None)
         self._relaunch_at.pop(index, None)
+
+    # -- fleet drain (graceful shutdown) -------------------------------------
+
+    def begin_drain(self) -> None:
+        """Fleet-level graceful shutdown gate (serve.py's SIGTERM path):
+        stop admitting — the gateway 503s new submissions — while
+        in-flight requests run to their terminals on their replicas;
+        /readyz flips not-ready so load balancers stop sending."""
+        self._draining = True
+        self.decisions.record("fleet_drain")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def active_requests(self) -> int:
+        """Router-level in-flight count (the graceful-drain wait
+        condition; mirrors EngineLoop.active_requests)."""
+        with self._live_lock:
+            return sum(
+                1
+                for r in self._live.values()
+                if r.status not in TERMINAL_STATUSES
+            )
+
+    # -- rolling weight upgrades ---------------------------------------------
+
+    def upgrade_replica(
+        self,
+        index: int,
+        update: Any = None,
+        *,
+        stop_timeout: float = 5.0,
+    ) -> bool:
+        """One step of a rolling upgrade: drain replica ``index``
+        (in-flight work redrives to survivors), apply ``update`` (a new
+        engine factory in-process; a worker-spec patch such as
+        ``{"model_path": ...}`` in process mode), relaunch HELD, and run
+        the pinned golden probes against the fresh engine BEFORE it
+        takes any traffic. Bit-exact probes promote it to active; any
+        divergence, probe error, or crash inside the vetting window
+        refuses the upgrade — the old weights are restored, re-vetted,
+        and reactivated, and clients only ever saw the vetted fleet.
+
+        Returns True when the upgrade took traffic, False when it was
+        refused (the replica is back on its previous weights — or
+        ejected into the health loop's backoff if even the rollback
+        engine cannot come up)."""
+        rep = self.replicas[index]
+        old = rep.update_snapshot()
+        with self._counters_lock:
+            self.counters["upgrades"] += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "upgrade_start", replica=index, generation=rep.generation
+            )
+        self.drain(index, stop_timeout=stop_timeout)
+        rep.apply_update(update)
+        ok, detail = self._relaunch_vetted(rep)
+        if ok:
+            rep.activate("upgrade")
+            self._count_relaunch(index)
+            self._backoff.pop(index, None)
+            self._relaunch_at.pop(index, None)
+            if self.bus is not None:
+                self.bus.emit(
+                    "upgrade_vetted", replica=index, detail=detail,
+                    generation=rep.generation,
+                )
+            return True
+        with self._counters_lock:
+            self.counters["upgrades_refused"] += 1
+        self.decisions.record(
+            "upgrade_refused", replica=index, reason=detail
+        )
+        if self.bus is not None:
+            self.bus.emit("upgrade_refused", replica=index, reason=detail)
+        rep.apply_update(old, replace=True)
+        ok, detail = self._relaunch_vetted(rep)
+        if ok:
+            rep.activate("upgrade rollback")
+            self._count_relaunch(index)
+            self._backoff.pop(index, None)
+            self._relaunch_at.pop(index, None)
+        else:
+            # Even the previous weights cannot come up vetted — hand the
+            # replica to the health loop's eject/backoff machinery.
+            rep.eject(f"upgrade rollback failed: {detail}")
+            self._relaunch_at[index] = (
+                self._clock() + self._next_backoff(index)
+            )
+        if self.bus is not None:
+            self.bus.emit(
+                "upgrade_rolled_back", replica=index, restored=ok,
+                detail=detail,
+            )
+        return False
+
+    def rolling_upgrade(
+        self, updates: Any = None, *, stop_timeout: float = 5.0
+    ) -> Dict[int, bool]:
+        """Upgrade the fleet one replica at a time (i is fully vetted
+        and back in traffic — or rolled back — before i+1 drains).
+        ``updates``: one update for every replica, or a dict keyed by
+        replica index (missing keys relaunch-as-is)."""
+        results: Dict[int, bool] = {}
+        for rep in self.replicas:
+            up = (
+                updates.get(rep.index)
+                if isinstance(updates, dict)
+                else updates
+            )
+            results[rep.index] = self.upgrade_replica(
+                rep.index, up, stop_timeout=stop_timeout
+            )
+        return results
+
+    def _relaunch_vetted(self, rep: Replica) -> Tuple[bool, str]:
+        """Relaunch ``rep`` held out of traffic and decode every pinned
+        probe on it, requiring bit-exact agreement with the fleet
+        baseline. With no pinned set (sentinel off and no probe_set
+        given) the launch is accepted unvetted — stated in the detail
+        so the event stream records the weaker guarantee."""
+        try:
+            rep.relaunch(stop_timeout=0.5, hold=True)
+        except Exception as e:
+            return False, f"relaunch failed: {e!r}"
+        probes = self._probe_set or []
+        if not probes:
+            return True, "unvetted (no probe set pinned)"
+        for n, probe in enumerate(probes):
+            try:
+                attempt = rep.loop.submit(
+                    list(probe.prompt), len(probe.expected), priority=-1
+                )
+                status, tokens, _info = attempt.result(
+                    timeout=self.probe_timeout_s
+                )
+            except Exception as e:
+                return False, f"vetting probe {n} failed: {e!r}"
+            if status != "done":
+                return False, f"vetting probe {n} status={status!r}"
+            if list(tokens) != list(probe.expected):
+                return False, (
+                    f"vetting probe {n} diverged from the pinned reference"
+                )
+        return True, f"{len(probes)} probes bit-exact"
 
     def _redrive_from(self, index: int, reason: str) -> None:
         """Fail over every live request currently on ``index``. Races
@@ -1099,11 +1309,15 @@ class Router:
 
     def readiness(self) -> Dict[str, Any]:
         per = {rep.index: rep.state for rep in self.replicas}
-        ready = any(rep.accepting for rep in self.replicas)
+        ready = (
+            any(rep.accepting for rep in self.replicas)
+            and not self._draining
+        )
         out = {
             "ready": ready,
             "replicas": per,
             "brownout": self.brownout_active,
+            "draining": self._draining,
         }
         if self.probe_interval_s > 0:
             out["integrity"] = self._integrity_snapshot()

@@ -1,0 +1,105 @@
+"""Length-prefixed JSON framing for the worker socket protocol.
+
+One frame = 4-byte big-endian payload length + UTF-8 JSON object. Both
+sides of the worker protocol (frontend/worker.py serving, frontend/
+remote_replica.py consuming) speak exactly this — the framing layer
+knows nothing about ops, so it can be unit-tested without JAX or a
+subprocess.
+
+Failure surface is deliberately small: every way the peer can vanish
+(EOF mid-length, EOF mid-payload, ECONNRESET, EPIPE, a closed fd)
+raises ``ConnectionLost`` so callers have a single except clause for
+"the other process is gone"; a frame that parses but is not a JSON
+object, or whose declared length exceeds ``MAX_FRAME_BYTES``, raises
+``ProtocolError`` — that peer is speaking garbage, not dying, and the
+two must not be conflated because only the first is redrivable.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict
+
+# A frame is one JSON op or one token batch — 64 MiB means a corrupt
+# length prefix fails fast instead of attempting a multi-GB recv.
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+class ConnectionLost(Exception):
+    """The peer process went away (EOF / reset / closed socket)."""
+
+
+class ProtocolError(Exception):
+    """The peer sent bytes that do not decode as a protocol frame."""
+
+
+def _json_default(obj: Any) -> Any:
+    """Last-resort encoder for numpy scalars and other debug payload
+    values; token ids and counters are native ints before they get
+    here, so this only runs for debug_engine-style snapshots."""
+    for cast in (int, float):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return str(obj)
+
+
+def encode_frame(payload: Dict[str, Any]) -> bytes:
+    """Serialize one frame (length prefix + JSON) to bytes."""
+    body = json.dumps(
+        payload, separators=(",", ":"), default=_json_default
+    ).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"frame of {len(body)} bytes exceeds MAX_FRAME_BYTES"
+        )
+    return _LEN.pack(len(body)) + body
+
+
+def send_frame(sock: socket.socket, payload: Dict[str, Any]) -> None:
+    """Send one frame; any OS-level send failure means the peer died."""
+    data = encode_frame(payload)
+    try:
+        sock.sendall(data)
+    except (OSError, ValueError) as e:  # ValueError: fd closed under us
+        raise ConnectionLost(f"send failed: {e}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (OSError, ValueError) as e:
+            raise ConnectionLost(f"recv failed: {e}") from e
+        if not chunk:
+            raise ConnectionLost(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    """Receive one frame; blocks until a full frame or the peer dies."""
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"declared frame length {length} exceeds MAX_FRAME_BYTES"
+        )
+    body = _recv_exact(sock, length)
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ProtocolError(f"frame payload is not JSON: {e}") from e
+    if not isinstance(payload, dict):
+        raise ProtocolError(
+            f"frame payload must be a JSON object, got "
+            f"{type(payload).__name__}"
+        )
+    return payload

@@ -1,0 +1,576 @@
+"""Out-of-process serving worker: one Replica behind a socket.
+
+``python -m pretraining_llm_tpu.frontend.worker --spec-json '...'``
+owns exactly one :class:`frontend.replica.Replica` (engine factory +
+admission + per-replica registry — the same internals the in-process
+fleet uses) and serves it over the length-prefixed JSON protocol in
+``frontend/wire.py``. The parent side is
+:class:`frontend.remote_replica.RemoteReplica`; together they move the
+replica fault domain across a real process boundary so a kill -9, a
+wedged loop, or a dropped connection exercises the SAME eject/redrive
+machinery the in-process drills do.
+
+Startup handshake: the worker binds an ephemeral port and prints one
+line — ``{"worker": {"port": ..., "pid": ...}}`` — to stdout BEFORE the
+slow engine build, then builds the engine and starts accepting. The
+parent connects immediately (the connect lands in the listen backlog)
+and sends ``hello``; the reply arrives once the engine is up, so the
+parent's hello timeout is the engine-build budget.
+
+Client protocol (every request frame carries ``id``; replies echo it):
+
+==============  ======================================================
+op              semantics
+==============  ======================================================
+hello           engine construction constants (validate_request inputs)
+submit          lane="replica" -> Replica.submit (state gate + fault
+                clock); lane="loop" -> EngineLoop.submit directly (the
+                sentinel/vetting path, priority -1, no fault clock) —
+                reply carries rid; token/end frames stream after it
+cancel          EngineLoop.cancel by rid
+drain           Replica.drain() (loop.begin_drain + state)
+health          running/draining/active_requests/last_turn_age_s/...
+metrics         EngineLoop.metrics() snapshot
+debug_requests  EngineLoop.debug_requests()
+debug_engine    EngineLoop.debug_engine()
+probe_set       build_probe_set on the worker's own params (serialized
+                prompts/expected) — runs on a side thread so health
+                polls stay live during the reference generates
+shutdown        reply ok, then loop.stop() and exit 0
+stall           NO reply, stop reading frames (fault drill: the parent
+                sees RPC timeouts from a process that is still alive)
+==============  ======================================================
+
+Unsolicited frames: ``{"token": rid, "t": tok}`` and ``{"end": rid,
+"status": ..., "info": ...}`` per streamed request, and ``{"op":
+"event", ...}`` forwarding the replica's bus events to the parent
+(``replica_state`` is filtered out — the parent's state machine is
+authoritative for fleet lifecycle events).
+
+Robustness hooks baked into the worker itself:
+
+- orphan detection: a reader thread blocks on stdin (the parent holds
+  the write end of the pipe and never writes); EOF means the parent
+  died, so the worker drains, waits briefly for in-flight work, and
+  exits — killed routers never leak workers. SIGTERM takes the same
+  path.
+- ``kill_after_submits: N`` in the spec: SIGKILL *itself* right after
+  acknowledging the Nth wire submit (either lane) — this is how the
+  mid-upgrade-kill drill crashes the upgrading worker inside its
+  probe-vetting window, deterministically.
+- ``corrupt_weights: true`` in the spec: the engine factory flips the
+  sign of the largest weight leaf after build (same mutation as the
+  ``corrupt_weights`` serving fault) — a checkpoint that serves wrong
+  answers without crashing, for refused-upgrade drills.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .wire import ConnectionLost, ProtocolError, recv_frame, send_frame
+
+_ORPHAN_DRAIN_S = 10.0
+
+
+def build_engine_factory(spec: Dict[str, Any]):
+    """Engine factory from a worker spec. Two weight sources:
+
+    - ``model_path``: load a checkpoint exactly like scripts/serve.py
+      (load_model_for_inference -> cast_params_for_inference ->
+      optional quantize_params_for_serving).
+    - ``preset`` + ``init_seed``: deterministic random init, the form
+      every CPU test and CI gate uses (both sides of a fleet init the
+      same params from the same seed, so cross-replica redrive
+      bit-identity holds without any checkpoint on disk).
+
+    Imports live here, not at module top: argparse errors and wire unit
+    tests must not pay (or require) the JAX import.
+    """
+    import dataclasses
+
+    import jax
+
+    from ..config import get_preset
+    from ..generation.serving import ServingEngine
+
+    model_path = str(spec.get("model_path") or "")
+    if model_path:
+        from ..generation.generate import (
+            cast_params_for_inference,
+            load_model_for_inference,
+        )
+
+        params, full_cfg = load_model_for_inference(
+            model_path, use_ema=bool(spec.get("ema", False))
+        )
+        cfg = full_cfg.model
+        params = cast_params_for_inference(params, cfg)
+    else:
+        from ..models import transformer
+
+        cfg = get_preset(str(spec.get("preset", "tiny"))).model
+        overrides = dict(spec.get("model_overrides") or {})
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        params = transformer.init_params(
+            cfg, jax.random.key(int(spec.get("init_seed", 0)))
+        )
+
+    quantize = str(spec.get("quantize") or "none")
+    if quantize != "none":
+        from ..models import quantize as quantize_mod
+
+        params = quantize_mod.quantize_params_for_serving(params, cfg)
+
+    if spec.get("corrupt_weights"):
+        from ..resilience.faults import ServingFaultInjector
+
+        holder = type("_ParamsHolder", (), {})()
+        holder.params = params
+        ServingFaultInjector._fire_corrupt_weights(holder)
+        params = holder.params
+
+    engine_kw = dict(spec.get("engine") or {})
+    engine_kw.setdefault("temperature", 0.0)
+    if quantize != "none":
+        engine_kw.setdefault("quantize", quantize)
+
+    def factory():
+        return ServingEngine(params, cfg, **engine_kw)
+
+    return factory
+
+
+class _ForwardBus:
+    """Bus facade handed to the worker's Replica: forwards events over
+    the wire instead of writing JSONL. ``replica_state`` is dropped
+    (the parent Replica state machine emits those); everything else is
+    buffered until a client is connected, then streamed."""
+
+    def __init__(self, worker: "WorkerServer") -> None:
+        self._worker = worker
+
+    def emit(self, kind: str, step: int = 0, **fields: Any) -> None:
+        if kind == "replica_state":
+            return
+        self._worker.send_event(kind, step, fields)
+
+    def close(self) -> None:  # Replica's _TaggedBus calls this; no-op
+        pass
+
+
+class WorkerServer:
+    def __init__(self, spec: Dict[str, Any]) -> None:
+        self.spec = spec
+        self.index = int(spec.get("index", 0))
+        self._kill_after = int(spec.get("kill_after_submits", 0))
+        self._wire_submits = 0
+        self._shutdown = threading.Event()
+        self._conn: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._event_buf: list = []
+        self._attempts: Dict[int, Any] = {}
+        self.replica = None  # set in start_replica()
+
+        host = str(spec.get("host", "127.0.0.1"))
+        self._listener = socket.create_server((host, 0))
+        self._listener.listen(4)
+        self.port = int(self._listener.getsockname()[1])
+
+    # ---- lifecycle --------------------------------------------------
+
+    def announce(self) -> None:
+        sys.stdout.write(
+            json.dumps({"worker": {"port": self.port, "pid": os.getpid()}})
+            + "\n"
+        )
+        sys.stdout.flush()
+
+    def start_replica(self) -> None:
+        from ..frontend.admission import AdmissionController
+        from ..frontend.replica import Replica
+
+        faults = None
+        fault_spec = str(self.spec.get("serving_faults") or "")
+        if fault_spec:
+            from ..resilience.faults import ServingFaultInjector
+
+            faults = ServingFaultInjector(fault_spec, bus=_ForwardBus(self))
+
+        admission_kw = dict(self.spec.get("admission") or {})
+        loop_kw = dict(self.spec.get("loop") or {})
+
+        def make_admission(reg, scope=""):
+            return AdmissionController(
+                registry=reg, scope=scope, **admission_kw
+            )
+
+        self.replica = Replica(
+            self.index,
+            build_engine_factory(self.spec),
+            bus=_ForwardBus(self),
+            tracer=None,
+            registry_labels=dict(self.spec.get("registry_labels") or {}),
+            admission_factory=make_admission,
+            fault_injector=faults,
+            loop_kwargs=loop_kw,
+        )
+        self.replica.start()
+
+    def start_orphan_watch(self) -> None:
+        threading.Thread(
+            target=self._watch_parent, name="worker-orphan", daemon=True
+        ).start()
+
+    def _watch_parent(self) -> None:
+        try:
+            # The parent holds our stdin pipe open and never writes;
+            # read() returning means the parent process is gone.
+            sys.stdin.buffer.read()
+        except Exception:
+            pass
+        self._drain_and_exit("orphaned (parent pipe closed)")
+
+    def _drain_and_exit(self, reason: str) -> None:
+        try:
+            sys.stderr.write(f"[worker {self.index}] {reason}; draining\n")
+            sys.stderr.flush()
+            rep = self.replica
+            if rep is not None and rep.loop is not None:
+                rep.loop.begin_drain()
+                deadline = time.monotonic() + _ORPHAN_DRAIN_S
+                while (
+                    time.monotonic() < deadline
+                    and rep.loop.active_requests > 0
+                ):
+                    time.sleep(0.05)
+                rep.stop(timeout=5.0)
+        finally:
+            os._exit(0)
+
+    # ---- wire output (single writer lock; drop when unconnected) ----
+
+    def _send(self, payload: Dict[str, Any]) -> None:
+        with self._wlock:
+            conn = self._conn
+            if conn is None:
+                return
+            try:
+                send_frame(conn, payload)
+            except ConnectionLost:
+                pass  # reader side notices and tears the connection down
+
+    def send_event(self, kind: str, step: int, fields: Dict[str, Any]) -> None:
+        frame = {"op": "event", "kind": kind, "step": step, "fields": fields}
+        with self._wlock:
+            conn = self._conn
+            if conn is None:
+                if len(self._event_buf) < 4096:
+                    self._event_buf.append(frame)
+                return
+            try:
+                send_frame(conn, frame)
+            except ConnectionLost:
+                pass
+
+    # ---- serving ----------------------------------------------------
+
+    def serve_forever(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._wlock:
+                self._conn = conn
+                buffered, self._event_buf = self._event_buf, []
+            for frame in buffered:
+                self._send(frame)
+            try:
+                self._serve_conn(conn)
+            except (ConnectionLost, ProtocolError):
+                pass
+            finally:
+                with self._wlock:
+                    self._conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                # The client is gone: its streams have no reader, and the
+                # parent will redrive them elsewhere — cancel so decode
+                # slots and KV blocks free up before any reconnect.
+                loop = self.replica.loop if self.replica else None
+                if loop is not None:
+                    for attempt in list(self._attempts.values()):
+                        try:
+                            loop.cancel(attempt)
+                        except Exception:
+                            pass
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            req = recv_frame(conn)
+            op = str(req.get("op", ""))
+            if op == "stall":
+                # Fault drill: go silent without dying. Stop reading so
+                # every parent RPC on this connection times out.
+                while not self._shutdown.wait(3600.0):
+                    pass
+                return
+            rid = req.get("id")
+            try:
+                handled = self._dispatch(op, req)
+            except Exception as e:  # handler bug: report, keep serving
+                self._send(
+                    {"id": rid, "error": "runtime", "message": repr(e)}
+                )
+                continue
+            if not handled:
+                self._send(
+                    {
+                        "id": rid,
+                        "error": "runtime",
+                        "message": f"unknown op {op!r}",
+                    }
+                )
+
+    def _dispatch(self, op: str, req: Dict[str, Any]) -> bool:
+        rid = req.get("id")
+        rep = self.replica
+        loop = rep.loop
+        if op == "hello":
+            eng = loop.engine
+            self._send(
+                {
+                    "id": rid,
+                    "ok": {
+                        "pid": os.getpid(),
+                        "generation": rep.generation,
+                        "vocab_size": int(eng.cfg.vocab_size),
+                        "context_length": int(eng.cfg.context_length),
+                        "max_seq": int(eng.max_seq),
+                        "block_size": int(eng.block_size),
+                        "n_blocks": int(eng.alloc.n_blocks),
+                        "max_batch": int(eng.max_batch),
+                        "temperature": float(eng.temperature),
+                    },
+                }
+            )
+            return True
+        if op == "submit":
+            self._handle_submit(rid, req)
+            return True
+        if op == "cancel":
+            attempt = self._attempts.get(int(req.get("rid", -1)))
+            if attempt is not None:
+                loop.cancel(attempt)
+            self._send({"id": rid, "ok": True})
+            return True
+        if op == "drain":
+            rep.drain()
+            self._send({"id": rid, "ok": True})
+            return True
+        if op == "health":
+            self._send({"id": rid, "ok": self._health()})
+            return True
+        if op == "metrics":
+            self._send({"id": rid, "ok": loop.metrics()})
+            return True
+        if op == "debug_requests":
+            self._send({"id": rid, "ok": loop.debug_requests()})
+            return True
+        if op == "debug_engine":
+            self._send({"id": rid, "ok": loop.debug_engine()})
+            return True
+        if op == "probe_set":
+            threading.Thread(
+                target=self._handle_probe_set,
+                args=(rid, req),
+                name="worker-probeset",
+                daemon=True,
+            ).start()
+            return True
+        if op == "shutdown":
+            self._send({"id": rid, "ok": True})
+            self._shutdown.set()
+            threading.Thread(
+                target=self._exit_clean, name="worker-exit", daemon=True
+            ).start()
+            return True
+        return False
+
+    def _handle_submit(self, rid: Any, req: Dict[str, Any]) -> None:
+        from ..frontend.admission import RejectedBusy, RejectedInfeasible
+        from ..frontend.replica import ReplicaUnavailable
+
+        rep = self.replica
+        prompt = [int(t) for t in req.get("prompt", [])]
+        max_new = req.get("max_new", 1)
+        deadline_s = req.get("deadline_s")
+        priority = int(req.get("priority", 0))
+        lane = str(req.get("lane", "replica"))
+        # The PARENT assigns the stream id: it registers the attempt
+        # before sending, so a token frame can never race the reply.
+        wrid = int(req.get("rid", 0))
+        try:
+            if lane == "loop":
+                attempt = rep.loop.submit(
+                    prompt, max_new, deadline_s=deadline_s, priority=priority
+                )
+            else:
+                attempt = rep.submit(
+                    prompt, max_new, deadline_s=deadline_s, priority=priority
+                )
+        except ValueError as e:
+            self._send({"id": rid, "error": "invalid", "message": str(e)})
+            return
+        except RejectedBusy as e:
+            self._send(
+                {
+                    "id": rid,
+                    "error": "busy",
+                    "message": e.reason,
+                    "retry_after_s": e.retry_after_s,
+                }
+            )
+            return
+        except RejectedInfeasible as e:
+            self._send(
+                {
+                    "id": rid,
+                    "error": "infeasible",
+                    "message": e.reason,
+                    "estimate_s": e.estimate_s,
+                }
+            )
+            return
+        except (ReplicaUnavailable, RuntimeError) as e:
+            self._send({"id": rid, "error": "unavailable", "message": str(e)})
+            return
+        self._wire_submits += 1
+        self._attempts[wrid] = attempt
+        self._send({"id": rid, "ok": {"rid": wrid}})
+        threading.Thread(
+            target=self._pump,
+            args=(wrid, attempt),
+            name=f"worker-pump-{wrid}",
+            daemon=True,
+        ).start()
+        if self._kill_after and self._wire_submits >= self._kill_after:
+            # mid-upgrade-kill drill: die AFTER acking the submit, so
+            # the parent is committed to waiting on this stream.
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _pump(self, wrid: int, attempt: Any) -> None:
+        try:
+            for ev in attempt.events():
+                if ev[0] == "token":
+                    self._send({"token": wrid, "t": int(ev[1])})
+                elif ev[0] == "end":
+                    self._send(
+                        {
+                            "end": wrid,
+                            "status": attempt.status,
+                            "info": dict(attempt.info),
+                        }
+                    )
+        finally:
+            self._attempts.pop(wrid, None)
+
+    def _handle_probe_set(self, rid: Any, req: Dict[str, Any]) -> None:
+        try:
+            from ..resilience.integrity import build_probe_set
+
+            eng = self.replica.engine
+            probes = build_probe_set(
+                eng.params,
+                eng.cfg,
+                n_probes=int(req.get("n_probes", 2)),
+                probe_len=int(req.get("probe_len", 9)),
+                max_new=int(req.get("max_new", 4)),
+            )
+            self._send(
+                {
+                    "id": rid,
+                    "ok": [
+                        {
+                            "prompt": [int(t) for t in p.prompt],
+                            "expected": [int(t) for t in p.expected],
+                        }
+                        for p in probes
+                    ],
+                }
+            )
+        except Exception as e:
+            self._send({"id": rid, "error": "runtime", "message": repr(e)})
+
+    def _health(self) -> Dict[str, Any]:
+        rep = self.replica
+        loop = rep.loop
+        failure = loop.failure
+        return {
+            "running": bool(loop.running),
+            "draining": bool(loop.draining),
+            "active_requests": int(loop.active_requests),
+            "last_turn_age_s": float(loop.last_turn_age_s()),
+            "generation": int(rep.generation),
+            "submits": int(rep.submits),
+            "state": rep.state,
+            "failure": repr(failure) if failure is not None else None,
+            "weight_fingerprint0": loop.weight_fingerprint0,
+            "weight_fingerprint": loop.weight_fingerprint,
+        }
+
+    def _exit_clean(self) -> None:
+        try:
+            self.replica.stop(timeout=5.0)
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        finally:
+            os._exit(0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Serving worker: one engine replica behind a socket"
+    )
+    parser.add_argument(
+        "--spec-json",
+        required=True,
+        help="worker spec as a JSON object (see module docstring)",
+    )
+    args = parser.parse_args(argv)
+    spec = json.loads(args.spec_json)
+    if not isinstance(spec, dict):
+        raise SystemExit("--spec-json must be a JSON object")
+
+    server = WorkerServer(spec)
+    server.announce()
+    signal.signal(
+        signal.SIGTERM,
+        lambda signum, frame: threading.Thread(
+            target=server._drain_and_exit,
+            args=("SIGTERM",),
+            daemon=True,
+        ).start(),
+    )
+    server.start_orphan_watch()
+    server.start_replica()
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

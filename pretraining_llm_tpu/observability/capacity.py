@@ -59,6 +59,8 @@ DECISION_KINDS = (
     "eject_replica",      # router declared a replica dead/wedged and stopped routing to it
     "redrive",            # an in-flight request failed over to a surviving replica
     "brownout_shed",      # fleet degraded: low-priority work shed at the router
+    "fleet_drain",        # graceful shutdown: router stopped admitting (503s)
+    "upgrade_refused",    # rolling upgrade failed probe vetting; rolled back
 
     # Output-integrity sentinel (resilience/integrity.py): a quarantine
     # costs every in-flight request on the replica a redrive, and a

@@ -80,6 +80,23 @@ EVENT_KINDS = (
     # output matched the pinned reference; mismatch events are the
     # checksum detectors firing; quarantine is the sentinel's verdict
     # (the matching ``quarantine`` decision carries the probe trace_id).
+    # Out-of-process workers (frontend/worker.py + remote_replica.py).
+    # worker_* events carry the replica index and, where known, the
+    # worker pid — obs_report --fleet joins a worker death (worker_exit
+    # with clean=false / worker_conn_lost) to the redrives and the
+    # replica_state recovery that followed it.
+    "worker_spawn",       # worker process launched: replica, pid, port, reason
+    "worker_exit",        # worker stopped: replica, pid, clean, returncode
+    "worker_conn_lost",   # parent<->worker socket died: replica, reason
+    "rpc_retry",          # idempotent worker RPC retried: replica, op, attempt
+    # Rolling weight upgrades (Router.upgrade_replica). The vetting
+    # verdict events are what proves traffic never reached an unvetted
+    # checkpoint: upgrade_vetted precedes the replica_state active
+    # transition, and a refusal carries the probe-divergence reason.
+    "upgrade_start",        # replica drained for upgrade: replica, generation
+    "upgrade_vetted",       # new weights passed golden probes: replica, detail
+    "upgrade_refused",      # probes failed; upgrade rejected: replica, reason
+    "upgrade_rolled_back",  # old weights restored (or ejected): replica, restored
     "fault_fired",               # armed corruption actually mutated engine state
     "integrity_probe",           # probe completed: replica, ok, probe, n_tokens
     "integrity_quarantine",      # replica pulled from service: replica, reason

@@ -56,7 +56,17 @@ SERVING_FAULT_KINDS = (
     "corrupt_kv_page",  # flip a published prefix-cache pool page in place
     "corrupt_weights",  # negate the largest param leaf (bit-rot drill)
     "wrong_token",      # force one out-of-vocab token id into the commit path
+    # Process-level kinds (frontend/remote_replica.py drills): executed by
+    # the PARENT against a worker process right after the triggering
+    # submit is accepted. In-process replicas arm them but nothing
+    # consumes the queue — they are no-ops without a process boundary.
+    "worker_kill",    # SIGKILL the worker process (hard crash, no cleanup)
+    "worker_stall",   # worker stops reading frames but stays alive
+    "conn_drop",      # sever the parent<->worker socket; both ends survive
 )
+
+# The subset above that needs a process boundary to mean anything.
+PROCESS_SERVING_FAULT_KINDS = ("worker_kill", "worker_stall", "conn_drop")
 
 # How long an injected hang blocks the host loop. Effectively forever next to
 # any sane watchdog timeout; bounded so a test run without a watchdog still
@@ -232,6 +242,29 @@ def parse_serving_faults(spec: str) -> List[ServingFault]:
     return out
 
 
+def split_serving_plan(spec: str) -> Tuple[str, str]:
+    """Split one plan string into (engine_plan, process_plan) — both in
+    the same ``kind@reqN[:rM]`` grammar, either possibly "". Process-mode
+    serving needs this because the two halves run in different
+    processes: engine kinds ride in each worker's spec and fire inside
+    its scheduler, while process kinds stay with the parent-side
+    injector that can actually kill/stall/sever a worker. Keeping one
+    user-facing plan string (``--serving_faults``) with both vocabularies
+    means drills read the same regardless of replica mode."""
+    parse_serving_faults(spec)  # validate once; errors name the entry
+    engine: List[str] = []
+    process: List[str] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind = entry.partition("@")[0]
+        (process if kind in PROCESS_SERVING_FAULT_KINDS else engine).append(
+            entry
+        )
+    return ",".join(engine), ",".join(process)
+
+
 class InjectedFault(RuntimeError):
     """Raised inside a replica's scheduler turn by ``replica_crash`` — the
     engine loop's failure path treats it like any real engine error."""
@@ -270,6 +303,7 @@ class ServingFaultInjector:
         self._slow: Dict[int, int] = {}          # replica -> slowed ticks left
         self._storm: Dict[int, int] = {}         # replica -> rejects left
         self._corrupt: Dict[int, List[str]] = {}  # replica -> corruption queue
+        self._process: Dict[int, List[str]] = {}  # replica -> process faults
         self._engines: Dict[int, Any] = {}       # replica -> live engine handle
 
     def attach_engine(self, replica: int, engine: Any) -> None:
@@ -300,6 +334,8 @@ class ServingFaultInjector:
                     )
                 if f.kind in ("replica_crash", "replica_hang"):
                     self._armed.setdefault(replica, []).append(f.kind)
+                elif f.kind in PROCESS_SERVING_FAULT_KINDS:
+                    self._process.setdefault(replica, []).append(f.kind)
                 elif f.kind in (
                     "corrupt_kv_page", "corrupt_weights", "wrong_token"
                 ):
@@ -321,6 +357,15 @@ class ServingFaultInjector:
                 return False
             self._storm[replica] = left - 1
             return True
+
+    def take_process_faults(self, replica: int) -> List[str]:
+        """Drain the armed process-level faults for ``replica``. Called
+        by RemoteReplica right after the triggering submit's reply, on
+        the submitting thread — the parent is the only party that can
+        kill/stall/sever a worker process. In-process fleets never call
+        this, which is exactly why process kinds are no-ops there."""
+        with self._lock:
+            return self._process.pop(replica, [])
 
     def wrap_tick(self, replica: int, tick: Any) -> Any:
         """Shim for ``engine.pipeline_tick``: checks armed actions before

@@ -13,6 +13,7 @@ JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_observability.py \
     tests/test_integrity.py \
+    tests/test_process_fleet.py \
     "tests/test_training.py::test_checkpoint_roundtrip_and_exact_resume" \
     "tests/test_training.py::test_checkpoint_retention" \
     "tests/test_training.py::test_checkpoint_sharded_leaf_reassembly" \
@@ -526,6 +527,103 @@ grep -q "lost=0" "$OBS_TMP/fleet_report.out" || {
     echo "obs_report --fleet did not report lost=0"; exit 1; }
 grep -q "redrive cost" "$OBS_TMP/fleet_report.out" || {
     echo "obs_report --fleet missing the redrive cost section"; exit 1; }
+
+# Process-fleet gate: the same drill across a REAL process boundary. Two
+# out-of-process workers (each its own engine in its own interpreter)
+# behind the router and real HTTP; one worker is SIGKILLed right after
+# accepting its 3rd request. Zero lost, at least one redrive onto the
+# survivor, the dead worker relaunched as a fresh process, and — after
+# shutdown — no orphaned worker processes left on the host.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import json, os, time, urllib.request
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+bus = EventBus(os.path.join(tmp, "proc_fleet_events.jsonl"))
+faults = ServingFaultInjector("worker_kill@req3:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_")
+spec = {
+    "preset": "tiny",
+    "init_seed": 0,
+    "model_overrides": {"compute_dtype": "float32"},
+    "engine": {"max_batch": 2, "n_blocks": 24, "block_size": 8,
+               "temperature": 0.0, "steps_per_sched": 4,
+               "pipeline_depth": 2},
+    "admission": {"max_queue_depth": 8},
+}
+replicas = [
+    RemoteReplica(i, spec, bus=bus, fault_injector=faults)
+    for i in range(2)
+]
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=0.2).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+load = LoadSpec(n_requests=12, mode="closed", concurrency=4, seed=9,
+                vocab_size=replicas[0].engine.cfg.vocab_size,
+                max_new_min=6, max_new_max=10)
+report = run_http(base, load)
+
+lost = load.n_requests - len(report.outcomes)
+assert lost == 0, f"{lost} requests lost"
+statuses = {}
+for o in report.outcomes:
+    statuses[o.status] = statuses.get(o.status, 0) + 1
+assert statuses == {"done": 12}, statuses
+summary = report.summary()
+assert summary["redrives_total"] >= 1, summary
+assert router.counters["ejects"] >= 1, router.counters
+
+# The killed worker must come back as a NEW process (backoff relaunch).
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if all(rep.accepting for rep in router.replicas):
+        break
+    time.sleep(0.05)
+assert router.replicas[0].generation >= 2, router.replicas[0].debug_snapshot()
+
+with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+    assert json.loads(r.read())["status"] == "ready"
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_worker_spawns_total" in text, text[:400]
+assert "pllm_serving_replica_relaunch_total" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"process-fleet smoke ok: {statuses}, "
+      f"redrives={router.counters['redrives']}, "
+      f"relaunches={router.counters['relaunches']}")
+EOF
+
+# No orphaned workers may survive the shutdown (the stdin-watch orphan
+# guard plus the router teardown must account for every child).
+if pgrep -f "pretraining_llm_tpu.frontend.worker" > /dev/null; then
+    echo "orphaned worker processes left after shutdown:"
+    pgrep -af "pretraining_llm_tpu.frontend.worker"
+    exit 1
+fi
+
+# The offline auditor must join the process death to the redrives it
+# caused and the relaunch that recovered it.
+python scripts/obs_report.py --fleet --strict \
+    "$OBS_TMP/proc_fleet_events.jsonl" > "$OBS_TMP/proc_fleet_report.out"
+grep -q "lost=0" "$OBS_TMP/proc_fleet_report.out" || {
+    echo "obs_report --fleet (process) did not report lost=0"; exit 1; }
+grep -q "worker death" "$OBS_TMP/proc_fleet_report.out" || {
+    echo "obs_report --fleet missing the worker death join"; exit 1; }
 
 # Integrity gate: a 2-replica fleet with golden probes on and a
 # corrupt_kv_page injected on replica 0 mid-burst — the flipped page is

@@ -811,6 +811,105 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "recovery_s": None,  # still down at end of log
             })
 
+    # Out-of-process workers: join each unclean worker death to the
+    # redrives it caused and the replica_state recovery that followed —
+    # the incident story ACROSS a real process boundary. Clean exits
+    # (drain/shutdown/upgrade teardown) are routine and not incidents.
+    w_spawns = [e for e in events if e.get("event") == "worker_spawn"]
+    w_exits = [e for e in events if e.get("event") == "worker_exit"]
+    w_conn_lost = [e for e in events if e.get("event") == "worker_conn_lost"]
+    rpc_retry_ev = [e for e in events if e.get("event") == "rpc_retry"]
+    process_deaths: List[Dict[str, Any]] = []
+    for e in w_exits:
+        if e.get("clean"):
+            continue
+        rep = int(e.get("replica", -1))
+        t0 = float(e.get("t_mono", 0.0))
+        recovery = next(
+            (
+                s for s in sorted(
+                    states, key=lambda s: float(s.get("t_mono", 0.0))
+                )
+                if int(s.get("replica", -2)) == rep
+                and s.get("state") == "active"
+                and float(s.get("t_mono", 0.0)) > t0
+            ),
+            None,
+        )
+        t_end = (
+            float(recovery.get("t_mono", 0.0))
+            if recovery is not None else float("inf")
+        )
+        # conn-loss detection can precede the reaped exit by a beat; give
+        # the join a small backwards grace window.
+        caused = [
+            r for r in redrives
+            if r.get("from_replica") == rep
+            and t0 - 1.0 <= float(r.get("t_mono", 0.0)) <= t_end
+        ]
+        process_deaths.append({
+            "replica": rep,
+            "pid": e.get("pid"),
+            "returncode": e.get("returncode"),
+            "redrives_caused": len(caused),
+            "tokens_carried_over": sum(
+                int(r.get("n_committed", 0)) for r in caused
+            ),
+            "recovered_in_s": (
+                float(recovery.get("t_mono", 0.0)) - t0
+                if recovery is not None else None
+            ),
+            "respawned": any(
+                int(s.get("replica", -2)) == rep
+                and float(s.get("t_mono", 0.0)) > t0
+                for s in w_spawns
+            ),
+        })
+    workers = None
+    if w_spawns or w_exits or w_conn_lost or rpc_retry_ev:
+        workers = {
+            "spawns": len(w_spawns),
+            "exits_clean": sum(1 for e in w_exits if e.get("clean")),
+            "exits_unclean": sum(1 for e in w_exits if not e.get("clean")),
+            "conn_lost": len(w_conn_lost),
+            "rpc_retries": len(rpc_retry_ev),
+            "process_deaths": process_deaths,
+        }
+
+    # Rolling upgrades: every refusal must be followed by a rollback that
+    # restored the old weights — a refused upgrade that left the replica
+    # on the new (probe-failing) checkpoint is the one unacceptable end
+    # state, so it is strict.
+    up_starts = [e for e in events if e.get("event") == "upgrade_start"]
+    up_vetted = [e for e in events if e.get("event") == "upgrade_vetted"]
+    up_refused = [e for e in events if e.get("event") == "upgrade_refused"]
+    up_rolled = [e for e in events if e.get("event") == "upgrade_rolled_back"]
+    for e in up_refused:
+        rep = e.get("replica")
+        t0 = float(e.get("t_mono", 0.0))
+        rb = next(
+            (
+                r for r in up_rolled
+                if r.get("replica") == rep
+                and float(r.get("t_mono", 0.0)) >= t0
+            ),
+            None,
+        )
+        if rb is None:
+            problems.append(
+                f"upgrade_refused on replica {rep} has no matching "
+                f"upgrade_rolled_back (replica left in limbo)"
+            )
+    upgrades = None
+    if up_starts or up_refused or up_rolled:
+        upgrades = {
+            "started": len(up_starts),
+            "vetted": len(up_vetted),
+            "refused": len(up_refused),
+            "rolled_back": len(up_rolled),
+            "restored": sum(1 for e in up_rolled if e.get("restored")),
+        }
+
     return {
         "n_submitted": len(submits),
         "n_terminal": len(terms),
@@ -820,6 +919,8 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "redrive_cost": redrive_cost,
         "incidents": incidents,
         "brownout_transitions": len(brownouts),
+        "workers": workers,
+        "upgrades": upgrades,
         "problems": problems,
     }
 
@@ -868,6 +969,33 @@ def print_fleet_report(report: Dict[str, Any]) -> None:
             )
     if report["brownout_transitions"]:
         print(f"brownout transitions: {report['brownout_transitions']}")
+    w = report.get("workers")
+    if w:
+        print("== workers ==")
+        print(
+            f"spawns={w['spawns']} exits_clean={w['exits_clean']} "
+            f"exits_unclean={w['exits_unclean']} conn_lost={w['conn_lost']} "
+            f"rpc_retries={w['rpc_retries']}"
+        )
+        for d in w["process_deaths"]:
+            rec = (
+                f"{d['recovered_in_s']:.3f}s"
+                if d["recovered_in_s"] is not None else "STILL DOWN"
+            )
+            print(
+                f"  worker death: replica {d['replica']} pid {d['pid']} "
+                f"(rc={d['returncode']}) -> {d['redrives_caused']} redrives "
+                f"({d['tokens_carried_over']} tokens carried), "
+                f"respawned={d['respawned']}, recovered in {rec}"
+            )
+    u = report.get("upgrades")
+    if u:
+        print("== upgrades ==")
+        print(
+            f"started={u['started']} vetted={u['vetted']} "
+            f"refused={u['refused']} rolled_back={u['rolled_back']} "
+            f"restored={u['restored']}"
+        )
     for p in report["problems"]:
         print(f"!! {p}")
 

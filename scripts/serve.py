@@ -134,6 +134,14 @@ def main() -> None:
                         "ejection + relaunch, drain/redrive of in-flight "
                         "requests. 1 = plain single engine loop (default: "
                         "config)")
+    parser.add_argument("--replica_mode", default=None,
+                        choices=["inproc", "process"],
+                        help="(--http) where replica engines live: "
+                        "'inproc' = EngineLoop threads in this process; "
+                        "'process' = one worker subprocess per replica "
+                        "behind a socket (real kill -9 fault domain, "
+                        "rolling weight upgrades). Router/gateway "
+                        "behavior is identical (default: config)")
     parser.add_argument("--serving_faults", default=None,
                         help="(--http) serving fault plan, e.g. "
                         "'replica_crash@req3:r0,slow_window@req5' — a "
@@ -324,10 +332,8 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
         prefix="pllm_serving_", const_labels={"quant_dtype": quantize}
     )
     n_replicas = pick(args.replicas, fc.replicas)
+    replica_mode = pick(args.replica_mode, fc.replica_mode)
     fault_spec = pick(args.serving_faults, fc.serving_faults)
-    faults = (
-        ServingFaultInjector(fault_spec, bus=bus) if fault_spec else None
-    )
     max_queue_depth = pick(args.max_queue_depth, fc.max_queue_depth)
     max_outstanding = pick(
         args.max_outstanding_tokens, fc.max_outstanding_tokens
@@ -343,23 +349,16 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             scope=scope,
         )
 
-    if n_replicas > 1:
-        replicas = [
-            Replica(
-                i, make_engine, bus=bus, tracer=tracer,
-                registry_labels={"quant_dtype": quantize},
-                admission_factory=make_admission, fault_injector=faults,
-                loop_kwargs=dict(
-                    idle_wait_s=fc.idle_wait_s, capacity_ring=fc.capacity_ring,
-                    weight_fingerprint_interval_s=pick(
-                        args.weight_fingerprint_interval_s,
-                        fc.weight_fingerprint_interval_s,
-                    ),
-                ),
-            )
-            for i in range(n_replicas)
-        ]
-        loop = Router(
+    loop_kwargs = dict(
+        idle_wait_s=fc.idle_wait_s, capacity_ring=fc.capacity_ring,
+        weight_fingerprint_interval_s=pick(
+            args.weight_fingerprint_interval_s,
+            fc.weight_fingerprint_interval_s,
+        ),
+    )
+
+    def make_router(replicas, extra_bus_faults_done=False):
+        return Router(
             replicas,
             admission=make_admission(registry, scope="fleet"),
             bus=bus, registry=registry, tracer=tracer,
@@ -368,7 +367,8 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             wedged_after_s=pick(args.wedged_after_s, fc.wedged_after_s),
             eject_backoff_s=fc.eject_backoff_s,
             eject_backoff_max_s=fc.eject_backoff_max_s,
-            redrive_max=fc.redrive_max,
+            backoff_seed=args.seed,
+            redrive_max=fc.redrive_max_attempts,
             brownout_min_healthy_frac=fc.brownout_min_healthy_frac,
             brownout_min_priority=fc.brownout_min_priority,
             brownout_max_deadline_s=fc.brownout_max_deadline_s,
@@ -376,7 +376,92 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             probe_count=pick(args.probe_count, fc.probe_count),
             probe_max_new=pick(args.probe_max_new, fc.probe_max_new),
         ).start()
+
+    if replica_mode == "process":
+        # One worker subprocess per replica. Workers load the checkpoint
+        # themselves from the spec (same load/cast/quantize pipeline as
+        # above); the fault plan splits into engine kinds (ride in the
+        # worker spec, fire inside its scheduler) and process kinds
+        # (worker_kill/worker_stall/conn_drop — executed by the parent,
+        # the only party that can kill a process).
+        from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+        from pretraining_llm_tpu.resilience.faults import split_serving_plan
+
+        if args.draft_model_path:
+            raise SystemExit(
+                "--replica_mode process does not support speculative "
+                "serving (--draft_model_path): draft params cannot ride "
+                "a JSON worker spec"
+            )
+        engine_plan, process_plan = (
+            split_serving_plan(fault_spec) if fault_spec else ("", "")
+        )
+        proc_faults = (
+            ServingFaultInjector(process_plan, bus=bus)
+            if process_plan else None
+        )
+        worker_spec = dict(
+            model_path=args.model_path,
+            ema=bool(args.ema),
+            quantize=quantize,
+            engine=dict(
+                max_batch=args.max_batch, n_blocks=args.n_blocks,
+                block_size=args.block_size, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
+                stop_token=args.stop_token, seed=args.seed,
+                steps_per_sched=args.steps_per_sched,
+                pipeline_depth=(
+                    args.pipeline_depth or cfg.serving.pipeline_depth
+                ),
+                admit_batch=args.admit_batch or cfg.serving.admit_batch,
+                prefix_cache=args.prefix_cache or cfg.serving.prefix_cache,
+                prefix_cache_min_blocks=(
+                    args.prefix_cache_min_blocks
+                    or cfg.serving.prefix_cache_min_blocks
+                ),
+                prefill_chunk_tokens=(
+                    args.prefill_chunk_tokens
+                    or cfg.serving.prefill_chunk_tokens
+                ),
+                kv_checksum=args.kv_checksum or cfg.serving.kv_checksum,
+            ),
+            admission=dict(
+                max_queue_depth=max_queue_depth,
+                max_outstanding_tokens=max_outstanding,
+                retry_after_s=fc.retry_after_s,
+                shed_infeasible=fc.shed_infeasible,
+            ),
+            loop=loop_kwargs,
+            serving_faults=engine_plan,
+        )
+        replicas = [
+            RemoteReplica(
+                i, worker_spec, bus=bus,
+                registry_labels={"quant_dtype": quantize},
+                fault_injector=proc_faults,
+                backoff_seed=args.seed,
+            )
+            for i in range(n_replicas)
+        ]
+        loop = make_router(replicas)
+    elif n_replicas > 1:
+        faults = (
+            ServingFaultInjector(fault_spec, bus=bus) if fault_spec else None
+        )
+        replicas = [
+            Replica(
+                i, make_engine, bus=bus, tracer=tracer,
+                registry_labels={"quant_dtype": quantize},
+                admission_factory=make_admission, fault_injector=faults,
+                loop_kwargs=loop_kwargs,
+            )
+            for i in range(n_replicas)
+        ]
+        loop = make_router(replicas)
     else:
+        faults = (
+            ServingFaultInjector(fault_spec, bus=bus) if fault_spec else None
+        )
         eng = make_engine()
         if faults is not None:
             eng.pipeline_tick = faults.wrap_tick(0, eng.pipeline_tick)
@@ -408,8 +493,14 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     )
     # SIGTERM (a plain `kill`, the orchestrator's stop signal) must take
     # the same graceful path as ^C: without this the process dies before
-    # the finally block and the whole trace export is lost.
+    # the finally block and the whole trace export is lost. SIGTERM
+    # additionally requests a fleet drain — stop admitting, let in-flight
+    # requests finish (or redrive), THEN tear down — because the
+    # orchestrator's kill is routine (rolling restart), not an emergency.
+    graceful = {"drain": False}
+
     def _sigterm(signum, frame):
+        graceful["drain"] = True
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _sigterm)
@@ -418,6 +509,19 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if graceful["drain"]:
+            begin = getattr(loop, "begin_drain", None)
+            if begin is not None:
+                begin()
+            deadline = time.monotonic() + 30.0
+            while (
+                getattr(loop, "active_requests", 0) > 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            print("[serve] SIGTERM drain complete "
+                  f"({getattr(loop, 'active_requests', 0)} still in flight)",
+                  file=sys.stderr)
         gateway.stop()
         clean = loop.stop()
         if clean is False:

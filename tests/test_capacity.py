@@ -113,6 +113,8 @@ def test_capacity_event_kinds_documented():
         "eject_replica", "redrive", "brownout_shed",
         # integrity sentinel (resilience/integrity.py + router)
         "quarantine", "drop_corrupt_block",
+        # process-worker fleet (frontend/worker.py + router)
+        "fleet_drain", "upgrade_refused",
     }
 
 

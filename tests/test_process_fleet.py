@@ -1,0 +1,520 @@
+"""Out-of-process serving workers: socket fault domain, redrive storm
+guards, and probe-vetted rolling weight upgrades.
+
+The correctness bar is test_fleet.py's, moved across a process
+boundary: a worker SIGKILLed mid-decode (or severed, or wedged) must
+cost zero requests — every in-flight request redrives to a surviving
+worker and finishes with greedy output BIT-IDENTICAL to a run that
+never saw the disturbance, at every pipeline depth, prefix cache on or
+off. Rolling upgrades are vetted by golden probes BEFORE the new
+worker takes traffic: a corrupt (or crashing) checkpoint is refused
+and the old weights restored without clients ever seeing it.
+
+Workers build their own params from (preset, init_seed) — the same
+``init_params(cfg, key(0))`` this module's reference engine uses — so
+bit-identity assertions compare real decode output across processes,
+not a mock.
+
+The subprocess drills are marked ``slow`` (each spawns real worker
+processes and builds engines; the module takes ~2.5 min end to end) so
+the tier-1 ``-m "not slow"`` run keeps only the wire/config unit tests;
+``ci_smoke.sh`` runs the full module explicitly.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import FrontendConfig, get_preset
+from pretraining_llm_tpu.frontend.loadgen import FleetAction, run_fleet_plan
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.frontend.wire import (
+    MAX_FRAME_BYTES,
+    ConnectionLost,
+    ProtocolError,
+    encode_frame,
+    recv_frame,
+    send_frame,
+)
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import (
+    MetricsRegistry,
+    render_merged,
+)
+from pretraining_llm_tpu.resilience.faults import (
+    ServingFaultInjector,
+    split_serving_plan,
+)
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_proc_fleet", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)])).tolist()
+        for i in range(n)
+    ]
+
+
+def _engine_kw(**kw):
+    out = dict(
+        max_batch=2, n_blocks=24, block_size=8, temperature=0.0,
+        steps_per_sched=4, pipeline_depth=2,
+    )
+    out.update(kw)
+    return out
+
+
+def _worker_spec(**engine_kw):
+    """Worker spec whose engine is config-identical to _undisturbed's —
+    same preset, same init seed, same scheduling geometry — so outputs
+    must match bit-for-bit across the process boundary."""
+    return {
+        "preset": "tiny",
+        "init_seed": 0,
+        "model_overrides": {"compute_dtype": "float32"},
+        "engine": _engine_kw(**engine_kw),
+        "admission": {"max_queue_depth": 8},
+    }
+
+
+def _undisturbed(params, prompts, n_new, **kw):
+    eng = ServingEngine(params, CFG, **_engine_kw(**kw))
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return {rids[rid]: toks for rid, toks in out.items()}
+
+
+def _proc_fleet(
+    n=2, faults=None, bus=None, engine_kw=None, replica_kw=None, **router_kw
+):
+    reps = [
+        RemoteReplica(
+            i,
+            _worker_spec(**(engine_kw or {})),
+            bus=bus,
+            fault_injector=faults,
+            **(replica_kw or {}),
+        )
+        for i in range(n)
+    ]
+    router_kw.setdefault("eject_backoff_s", 60.0)
+    return Router(reps, bus=bus, **router_kw)
+
+
+def _kill_worker(rep):
+    proc = rep.proc
+    if proc is not None:
+        proc.kill()
+
+
+# -- wire framing (no JAX, no subprocess) -----------------------------------
+
+
+def test_wire_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        payload = {"op": "submit", "prompt": [1, 2, 3], "rid": 7, "s": "x"}
+        send_frame(a, payload)
+        assert recv_frame(b) == payload
+        send_frame(b, {"id": 1, "ok": True})
+        assert recv_frame(a) == {"id": 1, "ok": True}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_peer_death_is_connection_lost():
+    a, b = socket.socketpair()
+    b.close()
+    with pytest.raises(ConnectionLost):
+        recv_frame(a)
+    a.close()
+
+
+def test_wire_truncated_frame_is_connection_lost():
+    a, b = socket.socketpair()
+    # Declare 100 bytes, deliver 3, hang up: the peer died mid-frame.
+    a.sendall(struct.pack(">I", 100) + b"abc")
+    a.close()
+    with pytest.raises(ConnectionLost):
+        recv_frame(b)
+    b.close()
+
+
+def test_wire_garbage_is_protocol_error_not_death():
+    a, b = socket.socketpair()
+    try:
+        # A frame that parses as JSON but is not an object: the peer is
+        # speaking garbage — NOT redrivable, must not look like death.
+        body = json.dumps([1, 2]).encode()
+        a.sendall(struct.pack(">I", len(body)) + body)
+        with pytest.raises(ProtocolError):
+            recv_frame(b)
+        # Oversized declared length fails fast instead of a huge recv.
+        a.sendall(struct.pack(">I", MAX_FRAME_BYTES + 1))
+        with pytest.raises(ProtocolError):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_oversized_payload_refused_at_send():
+    with pytest.raises(ProtocolError):
+        encode_frame({"blob": "x" * (MAX_FRAME_BYTES + 16)})
+
+
+# -- fault-plan split across the process boundary ---------------------------
+
+
+def test_split_serving_plan():
+    engine, process = split_serving_plan(
+        "replica_crash@req2:r0, worker_kill@req3:r1, slow_window@req5,"
+        " conn_drop@req1:r0, worker_stall@req4"
+    )
+    assert engine == "replica_crash@req2:r0,slow_window@req5"
+    assert process == "worker_kill@req3:r1,conn_drop@req1:r0,worker_stall@req4"
+    assert split_serving_plan("replica_crash@req1") == (
+        "replica_crash@req1", ""
+    )
+    with pytest.raises(ValueError):
+        split_serving_plan("worker_vaporize@req1")
+
+
+def test_fleet_action_upgrade_validation():
+    act = FleetAction(
+        at_s=0.5, kind="upgrade", replica=0, update={"model_path": "x"}
+    )
+    assert act.update == {"model_path": "x"}
+    with pytest.raises(ValueError):
+        FleetAction(at_s=0.5, kind="kill", replica=0, update={"x": 1})
+    with pytest.raises(ValueError):
+        FleetAction(at_s=0.5, kind="defrag", replica=0)
+
+
+def test_frontend_config_replica_mode():
+    assert FrontendConfig().replica_mode == "inproc"
+    assert FrontendConfig(replica_mode="process").redrive_max_attempts == 3
+    with pytest.raises(ValueError, match="replica_mode"):
+        FrontendConfig(replica_mode="thread")
+    with pytest.raises(ValueError, match="redrive_max_attempts"):
+        FrontendConfig(redrive_max_attempts=-1)
+
+
+# -- worker death mid-decode: zero lost, bit-identical ----------------------
+
+
+# The (depth=2, cache=False) cell of the acceptance grid lives in
+# test_worker_death_obs_join_and_relaunch below, which additionally
+# pins the relaunch and the offline report joins — one fleet, one set
+# of worker spawns, both contracts.
+_KILL_GRID = [(1, False), (1, True), (2, True), (3, False), (3, True)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth,cache", _KILL_GRID)
+def test_worker_kill9_bit_identity(params, depth, cache):
+    """SIGKILL a worker with requests mid-decode: the parent sees the
+    socket die, ejects the replica, and redrives every in-flight request
+    onto the survivor — final greedy outputs bit-identical to a run that
+    never saw the kill, at every pipeline depth, prefix cache on/off."""
+    prompts = _prompts(4)
+    n_new = 6
+    kw = dict(pipeline_depth=depth, prefix_cache=cache)
+    ref = _undisturbed(params, prompts, n_new, **kw)
+
+    faults = ServingFaultInjector("worker_kill@req2:r0")
+    router = _proc_fleet(faults=faults, engine_kw=kw)
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], f"request {i} diverged after worker kill"
+    assert router.counters["redrives"] >= 1
+    assert router.counters["ejects"] == 1
+    assert sum(1 for _, _, inf in results if inf["redrives"] > 0) >= 1
+
+
+@pytest.mark.slow
+def test_conn_drop_redrives_without_killing_worker(params):
+    """Severing the socket (worker process still healthy) must look like
+    death from the parent's side: eject, redrive, zero lost — the fault
+    domain is the CONNECTION, not the process."""
+    prompts = _prompts(4)
+    ref = _undisturbed(params, prompts, 6)
+    faults = ServingFaultInjector("conn_drop@req2:r0")
+    router = _proc_fleet(faults=faults)
+    with router:
+        reqs = [router.submit(p, 6) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        assert router.replicas[0].state == "ejected"
+        # The severed worker is alive and orphan-watching; kill it so
+        # teardown doesn't wait out its proc.wait grace.
+        _kill_worker(router.replicas[0])
+    for i, (status, tokens, _) in enumerate(results):
+        assert status == "done"
+        assert tokens == ref[i]
+    assert router.counters["redrives"] >= 1
+    assert router.counters["ejects"] >= 1
+
+
+@pytest.mark.slow
+def test_worker_stall_detected_by_rpc_timeout(params):
+    """A wedged worker (alive, accepting bytes, never replying) is
+    detected by RPC timeout + retry exhaustion, declared dead, and its
+    requests redrive — the timeout path, not the EOF path."""
+    prompts = _prompts(4)
+    ref = _undisturbed(params, prompts, 6)
+    faults = ServingFaultInjector("worker_stall@req2:r0")
+    router = _proc_fleet(
+        faults=faults,
+        replica_kw=dict(rpc_timeout_s=0.6, rpc_retries=1),
+    )
+    with router:
+        reqs = [router.submit(p, 6) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        stalled = router.replicas[0]
+        assert stalled.registry.counter(
+            "worker_rpc_timeouts_total", ""
+        ).value >= 1
+        assert stalled.registry.counter(
+            "worker_rpc_retries_total", ""
+        ).value >= 1
+        # The wedged worker never answers a shutdown RPC; kill it so
+        # teardown is immediate.
+        _kill_worker(stalled)
+    for i, (status, tokens, _) in enumerate(results):
+        assert status == "done"
+        assert tokens == ref[i]
+    assert router.counters["ejects"] >= 1
+
+
+@pytest.mark.slow
+def test_worker_death_obs_join_and_relaunch(params, tmp_path):
+    """The (depth=2, cache off) cell of the kill grid, plus the full
+    robustness loop observable end-to-end: worker dies -> redrives
+    (bit-identical) -> replica relaunched (fresh worker process) ->
+    fleet healthy; the event stream passes the strict fleet gate and
+    the workers section joins the death to the redrives it caused."""
+    prompts = _prompts(4)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new)
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(jsonl_path=str(path))
+    faults = ServingFaultInjector("worker_kill@req2:r0", bus=bus)
+    registry = MetricsRegistry("pllm_serving_")
+    router = _proc_fleet(
+        faults=faults, bus=bus, registry=registry, eject_backoff_s=0.2
+    )
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        for i, r in enumerate(reqs):
+            status, tokens, _ = r.result(timeout=120)
+            assert status == "done"
+            assert tokens == ref[i], f"request {i} diverged after kill"
+        assert router.counters["redrives"] >= 1
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(rep.accepting for rep in router.replicas):
+                break
+            time.sleep(0.05)
+        assert all(rep.accepting for rep in router.replicas)
+        assert router.replicas[0].generation >= 2
+        assert router.counters["relaunches"] >= 1
+        text = render_merged(
+            [registry] + [rep.registry for rep in router.replicas]
+        )
+        assert lint_exposition(text) == []
+        assert "pllm_serving_worker_spawns_total" in text
+        assert "pllm_serving_replica_relaunch_total" in text
+    bus.close()
+
+    events = [json.loads(ln) for ln in path.read_text().splitlines()]
+    report = obs_report.build_fleet_report(events)
+    assert report["problems"] == []
+    assert report["lost_requests"] == 0
+    assert report["statuses"] == {"done": 4}
+    w = report["workers"]
+    assert w["spawns"] >= 3  # 2 initial + >=1 relaunch
+    assert w["exits_unclean"] >= 1
+    deaths = [d for d in w["process_deaths"] if d["replica"] == 0]
+    assert deaths and deaths[0]["redrives_caused"] >= 1
+    assert deaths[0]["respawned"]
+
+
+@pytest.mark.slow
+def test_worker_orphan_exits_when_parent_pipe_closes():
+    """A worker whose parent vanished (stdin pipe EOF) must drain and
+    exit on its own — no leaked engine processes behind a dead server."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "pretraining_llm_tpu.frontend.worker",
+            "--spec-json", json.dumps(_worker_spec()),
+        ],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        env=env,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert json.loads(line)["worker"]["pid"] == proc.pid
+        proc.stdin.close()  # the parent "dies"
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# -- redrive storm guard ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_redrive_budget_exhaustion_is_terminal(params):
+    """A request whose redrive budget is exhausted gets a CLEAN error
+    terminal — not an infinite redrive storm — while the fleet heals and
+    survivors' allocators account every block."""
+    prompts = _prompts(5)
+    faults = ServingFaultInjector("replica_crash@req2:r0")
+
+    def factory():
+        return ServingEngine(params, CFG, **_engine_kw())
+
+    reps = [
+        Replica(i, factory, fault_injector=faults) for i in range(2)
+    ]
+    router = Router(reps, eject_backoff_s=0.1, redrive_max=0)
+    with router:
+        reqs = [router.submit(p, 6) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        exhausted = [
+            (status, info) for status, _, info in results
+            if status == "error"
+        ]
+        assert exhausted, "the crash must have caught requests in flight"
+        for status, info in exhausted:
+            assert "redrive budget exhausted" in info["reason"], info
+        assert all(status in ("done", "error") for status, _, _ in results)
+        assert router.counters["redrives"] == 0
+        # The fleet heals: the crashed replica relaunches and accepts.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(rep.accepting for rep in router.replicas):
+                break
+            time.sleep(0.05)
+        assert all(rep.accepting for rep in router.replicas)
+        # Survivor accounting: all blocks freed (one block is the
+        # allocator's reserved null page, as in an undisturbed engine).
+        assert reps[1].engine.alloc.available == 24 - 1
+
+
+# -- probe-vetted rolling upgrades ------------------------------------------
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_vetting_gates_traffic(params, tmp_path):
+    """Clean upgrade: drained, relaunched, probe-vetted, THEN active.
+    Corrupt upgrade: probes diverge on the held worker -> refused, old
+    spec restored verbatim, replica re-vetted and back in service —
+    clients never see the unvetted weights."""
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(jsonl_path=str(path))
+    prompts = _prompts(3)
+    ref = _undisturbed(params, prompts, 6)
+    router = _proc_fleet(bus=bus, probe_interval_s=60.0)
+    with router:
+        assert router.upgrade_replica(0) is True
+        rep = router.replicas[0]
+        assert rep.state == "active"
+        assert rep.generation == 2
+
+        assert router.upgrade_replica(0, {"corrupt_weights": True}) is False
+        assert rep.state == "active"
+        assert "corrupt_weights" not in rep.spec
+        assert router.counters["upgrades"] == 2
+        assert router.counters["upgrades_refused"] == 1
+
+        reqs = [router.submit(p, 6) for p in prompts]
+        for i, r in enumerate(reqs):
+            status, tokens, _ = r.result(timeout=120)
+            assert status == "done"
+            assert tokens == ref[i]
+    bus.close()
+
+    events = [json.loads(ln) for ln in path.read_text().splitlines()]
+    report = obs_report.build_fleet_report(events)
+    assert report["problems"] == []
+    u = report["upgrades"]
+    assert u["started"] == 2
+    assert u["vetted"] == 1
+    assert u["refused"] == 1
+    assert u["rolled_back"] == 1
+    assert u["restored"] == 1
+
+
+@pytest.mark.slow
+def test_mid_upgrade_kill_never_exposes_unvetted_weights(params):
+    """Satellite drill: the upgraded worker carries corrupt weights AND
+    SIGKILLs itself on its first vetting probe, while client traffic is
+    live. The upgrade must be refused, the old-weights replica restored,
+    and every client answer bit-identical to an undisturbed run — proof
+    traffic never touched the unvetted checkpoint."""
+    prompts = _prompts(6)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new)
+    router = _proc_fleet(probe_interval_s=60.0)
+    with router:
+        plan = run_fleet_plan(router, [
+            FleetAction(
+                at_s=0.3, kind="upgrade", replica=0,
+                update={"corrupt_weights": True, "kill_after_submits": 1},
+            ),
+        ])
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+        plan.join(timeout=120)
+        assert not plan.is_alive()
+        rep = router.replicas[0]
+        assert router.counters["upgrades_refused"] == 1
+        assert rep.state == "active"
+        assert "corrupt_weights" not in rep.spec
+        assert "kill_after_submits" not in rep.spec
+        assert all(r.accepting for r in router.replicas)
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], f"request {i} saw unvetted weights"
