@@ -261,7 +261,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--fleet-scenario", default="kill",
-        choices=["kill", "rolling", "hotprefix", "upgrade", "proc-kill"],
+        choices=[
+            "kill", "rolling", "hotprefix", "upgrade", "proc-kill",
+            "partition",
+        ],
         help="serving-fleet mode: kill = deterministic replica_crash on "
         "replica 0 one third into the burst (redrive drill); rolling = "
         "drain/restore each replica in turn under load; hotprefix = "
@@ -270,7 +273,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         "rolling weight upgrade of every replica while the burst runs "
         "(zero client-visible errors expected); proc-kill = out-of-process "
         "worker fleet (RemoteReplica), SIGKILL worker 0 mid-burst and "
-        "measure redrive + relaunch across a real process death",
+        "measure redrive + relaunch across a real process death; "
+        "partition = out-of-process fleet, blackhole worker 0 mid-decode "
+        "(reads hang, writes buffer — no RST), lease expiry redrives its "
+        "work, heal after redrive and count the stale-generation frames "
+        "the fence filter drops (zero lost + zero duplicated invariants "
+        "recorded)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -836,10 +844,14 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     relaunches it), 'rolling' drains/restores every replica in turn,
     'hotprefix' sends zipf-skewed shared-prefix traffic to measure
     prefix-affinity placement, 'upgrade' rolls a probe-vetted weight
-    upgrade across every replica under load, and 'proc-kill' runs the
-    fleet as out-of-process workers and SIGKILLs one mid-burst. Reports
-    goodput plus the fleet-only numbers: redrive count/cost, ejects,
-    per-replica request spread."""
+    upgrade across every replica under load, 'proc-kill' runs the
+    fleet as out-of-process workers and SIGKILLs one mid-burst, and
+    'partition' blackholes an out-of-process worker's socket mid-decode
+    (the lease detects it, redrive moves its work, a scheduled heal
+    floods the fence filter with stale frames). Reports goodput plus
+    the fleet-only numbers: redrive count/cost, ejects, per-replica
+    request spread — and for 'partition' the zero-lost /
+    zero-duplicate invariants plus lease/fence counters."""
     import jax
 
     from pretraining_llm_tpu.config import get_preset
@@ -924,16 +936,21 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         # by construction, deterministic under the seeded schedule.
         faults = ServingFaultInjector(f"replica_crash@req{kill_at}:r0")
 
-    if args.fleet_scenario == "proc-kill":
+    if args.fleet_scenario in ("proc-kill", "partition"):
         # Out-of-process fleet: each replica is a worker subprocess that
         # inits the SAME params from the same (preset, init_seed=0) the
         # parent's decode_bench_workload used, so redriven requests land
         # on bit-identical weights. worker_kill is a real SIGKILL,
         # executed by the parent injector right after replica 0 acks its
-        # kill_at'th submit.
+        # kill_at'th submit; partition blackholes replica 0's socket at
+        # the same trigger (detection is then the lease, not the fd).
         from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
 
-        faults = ServingFaultInjector(f"worker_kill@req{kill_at}:r0")
+        fault_kind = (
+            "partition" if args.fleet_scenario == "partition"
+            else "worker_kill"
+        )
+        faults = ServingFaultInjector(f"{fault_kind}@req{kill_at}:r0")
         worker_spec = {
             "preset": args.preset,
             "init_seed": 0,
@@ -953,8 +970,14 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             },
             "admission": {"max_queue_depth": 4 * max_batch},
         }
+        # The partition drill needs a short lease so detection (and thus
+        # redrive) lands well inside the burst; proc-kill keeps the
+        # default stdin-orphan + conn-EOF detection path.
+        rep_kw = (
+            {"lease_s": 1.0} if args.fleet_scenario == "partition" else {}
+        )
         replicas = [
-            RemoteReplica(i, worker_spec, fault_injector=faults)
+            RemoteReplica(i, worker_spec, fault_injector=faults, **rep_kw)
             for i in range(args.replicas)
         ]
     else:
@@ -972,7 +995,13 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         admission=AdmissionController(
             max_queue_depth=4 * max_batch * args.replicas
         ),
-        eject_backoff_s=0.2,
+        # For the partition drill the backoff must outlast the scheduled
+        # heal: relaunch tears down the blackholed gate, and with it the
+        # kernel backlog whose post-heal flush exercises the fence
+        # filter. Everywhere else a fast relaunch is the point.
+        eject_backoff_s=(
+            3.0 if args.fleet_scenario == "partition" else 0.2
+        ),
         # The upgrade drill vets new weights against golden probes before
         # they take traffic; a pinned probe set requires the sentinel to
         # be on (interval far beyond the burst keeps it out of the way).
@@ -1024,11 +1053,31 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
                     for i in range(args.replicas)
                 ],
             )
+        elif args.fleet_scenario == "partition":
+            # Heal replica 0 after the lease has expired and the router
+            # has redriven + ejected (fence bumped): the flushed backlog
+            # then arrives stamped with the old generation and every
+            # frame must be counted and dropped, never streamed.
+            kill_est = kill_at * args.replicas / args.rate_rps
+            plan_th = run_fleet_plan(
+                router,
+                [FleetAction(at_s=kill_est + 2.5, kind="heal", replica=0)],
+            )
         report = run_engine_loop(router, spec)
         if plan_th is not None:
             plan_th.join(timeout=60.0)
         per_replica = {rep.index: rep.submits for rep in replicas}
         counters = dict(router.counters)
+        lease_expiries = sum(
+            int(getattr(rep, "_c_lease", None).value)
+            if getattr(rep, "_c_lease", None) is not None else 0
+            for rep in replicas
+        )
+        fenced_frames = sum(
+            int(getattr(rep, "_c_fenced", None).value)
+            if getattr(rep, "_c_fenced", None) is not None else 0
+            for rep in replicas
+        )
     finally:
         router.stop()
     s = report.summary()
@@ -1057,7 +1106,9 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             "upgrades_refused": counters.get("upgrades_refused", 0),
         },
         "replica_mode": (
-            "process" if args.fleet_scenario == "proc-kill" else "inproc"
+            "process"
+            if args.fleet_scenario in ("proc-kill", "partition")
+            else "inproc"
         ),
         "per_replica_submits": per_replica,
         "lost_requests": lost,
@@ -1079,6 +1130,17 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         rec["prefix_pool_size"] = pfx_pool
         rec["prefix_len"] = pfx_len
         rec["prefix_zipf"] = args.prefix_zipf
+    if args.fleet_scenario == "partition":
+        # Partition-heal invariants: nothing lost (every scheduled
+        # request got a terminal), nothing duplicated (no done request
+        # overran its token budget — the fence filter dropped the
+        # blackholed attempt's late frames instead of appending them).
+        rec["lease_expiries"] = lease_expiries
+        rec["fenced_frames"] = fenced_frames
+        rec["duplicate_overruns"] = sum(
+            1 for o in report.outcomes
+            if o.status == "done" and o.n_tokens > new_tokens
+        )
     return rec
 
 
@@ -1588,6 +1650,14 @@ def _attempt(args: argparse.Namespace, remat: str, timeout: float, attention: st
         cmd += ["--prefill-chunk-tokens", str(args.prefill_chunk_tokens)]
     if args.quantize:
         cmd += ["--quantize", args.quantize]
+    if args.mode == "serving-fleet":
+        cmd += [
+            "--replicas", str(args.replicas),
+            "--fleet-scenario", args.fleet_scenario,
+            "--rate-rps", str(args.rate_rps),
+        ]
+        if args.n_requests:
+            cmd += ["--n-requests", str(args.n_requests)]
     if args.mode == "serving-slo":
         cmd += [
             "--rate-rps", str(args.rate_rps),

@@ -895,6 +895,27 @@ class FrontendConfig:
     brownout_min_priority: int = 1
     # ... or deadline longer than this (0 = don't shed on deadline).
     brownout_max_deadline_s: float = 0.0
+    # ---- multi-host fleet (replica_mode="process" only). -------------
+    # Attach to pre-spawned workers (``worker.py --listen host:port``)
+    # instead of spawning subprocesses: comma-separated "host:port" list,
+    # one address per replica ("" = spawn locally). Attached workers are
+    # detached (never killed) at teardown, and the stdin-orphan watch is
+    # replaced by heartbeat leases.
+    worker_attach: str = ""
+    # Shared secret for the attach handshake: the first frame on a new
+    # connection must be a hello carrying this token or the worker drops
+    # the connection ("" = no auth; spawn mode ignores it).
+    attach_token: str = ""
+    # Heartbeat lease: a worker that hears nothing from its router for
+    # this long stops admitting, drains, and parks; the router, hearing
+    # nothing back, redrives the worker's in-flight work. 0 disables
+    # (spawn mode's stdin-orphan + conn-EOF detection still applies).
+    lease_s: float = 0.0
+    # Write-ahead fleet journal (append-only JSONL): membership, fence
+    # generations, and per-request committed frontiers, enough for a
+    # restarted router to re-attach survivors, fence the old generation,
+    # and redrive in-flight requests bit-identically ("" = no journal).
+    journal_path: str = ""
     # Serving-path fault plan, e.g. "replica_crash@req3:r0,slow_window@req5"
     # ("" = none). See resilience.faults.parse_serving_faults.
     serving_faults: str = ""
@@ -990,6 +1011,28 @@ class FrontendConfig:
                 f"redrive_max_attempts must be >= 0, got "
                 f"{self.redrive_max_attempts}"
             )
+        if self.lease_s < 0:
+            raise ValueError(f"lease_s must be >= 0, got {self.lease_s}")
+        if self.worker_attach:
+            if self.replica_mode != "process":
+                raise ValueError(
+                    "worker_attach needs replica_mode='process', got "
+                    f"{self.replica_mode!r}"
+                )
+            addrs = [a.strip() for a in self.worker_attach.split(",")]
+            if len(addrs) != self.replicas:
+                raise ValueError(
+                    f"worker_attach lists {len(addrs)} addresses for "
+                    f"{self.replicas} replicas"
+                )
+            for a in addrs:
+                host, _, port_s = a.rpartition(":")
+                if not host or not port_s.isdigit():
+                    raise ValueError(
+                        f"worker_attach address {a!r} is not host:port"
+                    )
+        if self.attach_token and not self.worker_attach:
+            raise ValueError("attach_token needs worker_attach addresses")
         if not 0.0 <= self.brownout_min_healthy_frac <= 1.0:
             raise ValueError(
                 "brownout_min_healthy_frac must be in [0, 1], got "

@@ -1,0 +1,140 @@
+"""Write-ahead fleet journal: the router's crash-recoverable control
+plane.
+
+Append-only JSONL, one record per line, written at every
+redrive-relevant transition so a restarted router can rebuild exactly
+the state it needs to finish what the dead one started:
+
+==========  ===========================================================
+rec         written when / carries
+==========  ===========================================================
+member      router start — replica index, mode (spawn/attach/inproc),
+            attach address if any
+fence       router start and every eject — the replica's fence
+            generation; recovery bumps past the MAX seen, so every
+            frame the old router's workers still have in flight is
+            stale by construction ("fence the old generation
+            everywhere")
+submit      request admitted — frid, prompt, max_new, priority,
+            deadline_s (write-ahead: BEFORE placement)
+frontier    redrive — the committed token frontier carried to the
+            survivor (token VALUES, not a count: recovery re-submits
+            ``prompt + tokens`` and greedy decode makes the
+            continuation bit-identical)
+terminal    request finished (any status) — recovery skips it
+==========  ===========================================================
+
+Recovery folds the records front to back (`recovery_plan`): live
+requests are submits without terminals, each at its last journaled
+frontier. Tokens streamed between the last frontier record and the
+crash are simply re-decoded — greedy determinism makes the full output
+identical, and exactly-once holds per router lifetime (terminal
+records are what dedups across the restart).
+
+Durability is flush-per-record (the OS page cache): the failure model
+is a crashed ROUTER PROCESS on a healthy host — the same machine
+restarts it. Torn final lines (crash mid-write) are tolerated on load.
+
+No engine, socket, or JAX dependency: unit-testable in tier 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class FleetJournal:
+    """Append-only JSONL writer with crash-tolerant load/replay."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f: Optional[Any] = open(self.path, "a", encoding="utf-8")
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            f = self._f
+            if f is None:
+                return  # closed under a racing pump terminal; drop
+            f.write(line)
+            f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def load(path: str) -> List[Dict[str, Any]]:
+        """Read every parseable record; a torn final line (crash
+        mid-append) is skipped, mirroring how a real WAL discards its
+        incomplete tail."""
+        records: List[Dict[str, Any]] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except FileNotFoundError:
+            pass
+        return records
+
+    @staticmethod
+    def recovery_plan(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Fold the journal into what a restarting router needs:
+
+        - ``fences``: per-replica MAX fence generation seen (the new
+          router bumps past these before any worker re-attaches).
+        - ``live``: frid -> {prompt, max_new, priority, deadline_s,
+          tokens, redrives} for every submit without a terminal, at its
+          last journaled frontier.
+        - ``next_frid``: one past the highest frid ever journaled, so
+          recovered and fresh requests never collide.
+        """
+        fences: Dict[int, int] = {}
+        live: Dict[int, Dict[str, Any]] = {}
+        next_frid = 0
+        for rec in records:
+            kind = rec.get("rec")
+            if kind == "fence":
+                idx = int(rec.get("replica", -1))
+                fences[idx] = max(
+                    fences.get(idx, 0), int(rec.get("fence", 0))
+                )
+            elif kind == "submit":
+                frid = int(rec["frid"])
+                next_frid = max(next_frid, frid + 1)
+                live[frid] = {
+                    "prompt": [int(t) for t in rec.get("prompt", [])],
+                    "max_new": int(rec.get("max_new", 1)),
+                    "priority": int(rec.get("priority", 0)),
+                    "deadline_s": rec.get("deadline_s"),
+                    "tokens": [],
+                    "redrives": 0,
+                }
+            elif kind == "frontier":
+                ent = live.get(int(rec.get("frid", -1)))
+                if ent is not None:
+                    ent["tokens"] = [int(t) for t in rec.get("tokens", [])]
+                    ent["redrives"] = int(rec.get("redrives", 0))
+            elif kind == "terminal":
+                live.pop(int(rec.get("frid", -1)), None)
+        return {"fences": fences, "live": live, "next_frid": next_frid}

@@ -436,18 +436,26 @@ class FleetAction:
                mid-upgrade-kill drill rides this action: an ``update``
                carrying ``kill_after_submits: 1`` makes the new worker die
                on its first vetting probe, which must roll the old weights
-               back without clients ever seeing the unvetted checkpoint.
+               back without clients ever seeing the unvetted checkpoint;
+      partition  blackhole the replica's worker connection (process mode):
+               reads hang and writes buffer — no RST, no EOF. Detection
+               is the lease/fence machinery, never the socket;
+      heal     flush the partitioned connection's buffered writes and
+               release its read backlog — the stale-generation frame
+               flood the router's fence filter must count and drop.
     """
 
     at_s: float
-    kind: str  # "kill" | "drain" | "restore" | "upgrade"
+    kind: str  # "kill" | "drain" | "restore" | "upgrade" | "partition" | "heal"
     replica: int
     # Spec/factory delta applied before the upgrade relaunch (upgrade
     # only). None means "relaunch with the current spec" — still vetted.
     update: Optional[Dict[str, Any]] = None
 
     def __post_init__(self) -> None:
-        if self.kind not in ("kill", "drain", "restore", "upgrade"):
+        if self.kind not in (
+            "kill", "drain", "restore", "upgrade", "partition", "heal"
+        ):
             raise ValueError(f"unknown fleet action kind {self.kind!r}")
         if self.at_s < 0:
             raise ValueError(f"at_s must be >= 0, got {self.at_s}")
@@ -512,6 +520,12 @@ def run_fleet_plan(router: Any, actions: List[FleetAction]) -> threading.Thread:
                     router.drain(act.replica)
                 elif act.kind == "upgrade":
                     router.upgrade_replica(act.replica, act.update)
+                elif act.kind in ("partition", "heal"):
+                    # Process-mode replicas only (RemoteReplica.partition/
+                    # heal); in-process replicas have no wire to cut.
+                    fn = getattr(router.replicas[act.replica], act.kind, None)
+                    if fn is not None:
+                        fn()
                 else:
                     router.restore(act.replica)
             except Exception:

@@ -32,6 +32,30 @@ Fault domain (the robustness core of this tier):
   worker can never accumulate orphans; the worker's own stdin-EOF
   watcher covers the reverse direction (dead parent).
 
+Multi-host extensions (``spec["attach"] = "host:port"``):
+
+- **attach mode** connects to a pre-spawned ``worker.py --listen``
+  instead of spawning; the hello carries ``spec["token"]`` plus the
+  router's fence generation and lease term, and teardown only closes
+  our end — the worker survives to serve the next attach (including a
+  restarted router recovering from its journal).
+- **leases**: with ``lease_s > 0`` the health poll becomes the
+  heartbeat. A poll window with no successful RPC for a full lease
+  term declares the lease expired: live attempts fail with the
+  redrivable ``engine failure`` prefix WITHOUT closing the socket —
+  the connection must survive so that when a partition heals, the
+  backlog the worker streamed into the void is still readable (and
+  countable) rather than destroyed with the fd.
+- **fencing**: ``fence`` is this replica's generation; the router
+  bumps it on eject. Every inbound frame stamped with an older
+  generation is dropped and counted (``fenced_frames_total``) — a
+  healed partition can never stream duplicate tokens into a request
+  a survivor already answered.
+- **partition injection**: every connection is wrapped in a
+  ``_PartitionGate`` so drills can blackhole it (reads hang, writes
+  buffer — no RST, unlike ``conn_drop``) and add wire delay/jitter;
+  ``heal()`` flushes buffered writes and releases the read backlog.
+
 The worker spec (see ``frontend/worker.py``) is stored on the replica;
 ``update_snapshot()``/``apply_update({...})`` snapshot and mutate it,
 which is how ``Router.upgrade_replica`` swaps a checkpoint path and —
@@ -44,6 +68,7 @@ import json
 import os
 import queue
 import random
+import select
 import socket
 import subprocess
 import sys
@@ -66,6 +91,115 @@ _REPO_ROOT = os.path.dirname(
 
 # Transport latency buckets: LAN-ish RPCs, 1ms..5s.
 _RPC_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 5.0)
+
+# One-way delay applied by the "wire_delay" injected fault.
+_WIRE_DELAY_S = 0.05
+
+
+class _PartitionGate:
+    """Socket wrapper that can simulate a network PARTITION, distinctly
+    from ``conn_drop``: a blackholed route produces no RST and no EOF —
+    reads simply hang and writes vanish into a buffer that never
+    drains. The gate reproduces exactly that: while partitioned,
+    ``recv`` ignores readable bytes (they stay queued in the kernel)
+    and ``send``/``sendall`` divert into ``_wbuf``. ``heal()`` flushes
+    the buffered writes and lets the read backlog through — the
+    stale-frame flood that fencing exists to absorb. ``set_delay``
+    models a slow WAN link (per-recv sleep with jitter). Transparent
+    passthrough when no fault is active.
+
+    ``recv`` polls via select rather than blocking in the kernel so a
+    partition injected while the reader is mid-``recv`` takes effect
+    within one poll tick, and ``close()`` always wakes it.
+    """
+
+    def __init__(self, sock: socket.socket, rng: Any = None) -> None:
+        self._sock = sock
+        self._partitioned = False
+        self._closed = False
+        self._wbuf = bytearray()
+        self._wlock = threading.Lock()
+        self._delay_s = 0.0
+        self._jitter_frac = 0.0
+        self._rng = rng if rng is not None else random.Random(0)
+
+    # -- fault controls ----------------------------------------------
+
+    def partition(self) -> None:
+        with self._wlock:
+            self._partitioned = True
+
+    def heal(self) -> None:
+        # Flush INSIDE the lock: a concurrent send observing
+        # partitioned=False must not interleave its bytes with the
+        # buffered backlog (a torn frame would kill the connection).
+        with self._wlock:
+            buf, self._wbuf = bytes(self._wbuf), bytearray()
+            self._partitioned = False
+            if buf and not self._closed:
+                try:
+                    self._sock.sendall(buf)
+                except OSError:
+                    pass  # peer gave up during the partition; reads will EOF
+
+    def set_delay(self, delay_s: float, jitter_frac: float = 0.0) -> None:
+        self._delay_s = max(0.0, float(delay_s))
+        self._jitter_frac = max(0.0, float(jitter_frac))
+
+    # -- socket surface ----------------------------------------------
+
+    def recv(self, n: int) -> bytes:
+        while True:
+            if self._closed:
+                raise OSError("socket closed")
+            if self._partitioned:
+                time.sleep(0.02)
+                continue
+            try:
+                r, _, _ = select.select([self._sock], [], [], 0.05)
+            except (OSError, ValueError):
+                raise OSError("socket closed")
+            if not r or self._partitioned:
+                continue
+            if self._delay_s > 0.0:
+                time.sleep(
+                    self._delay_s
+                    * (1.0 + self._jitter_frac * self._rng.random())
+                )
+            return self._sock.recv(n)
+
+    def send(self, data: bytes, flags: int = 0) -> int:
+        with self._wlock:
+            if self._partitioned:
+                self._wbuf.extend(data)
+                return len(data)
+            return self._sock.send(data, flags)
+
+    def sendall(self, data: bytes) -> None:
+        with self._wlock:
+            if self._partitioned:
+                self._wbuf.extend(data)
+                return
+            self._sock.sendall(data)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def setsockopt(self, *args: Any) -> None:
+        self._sock.setsockopt(*args)
+
+    def settimeout(self, t: Optional[float]) -> None:
+        self._sock.settimeout(t)
+
+    def shutdown(self, how: int) -> None:
+        self._sock.shutdown(how)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class _RemoteEngine:
@@ -290,6 +424,7 @@ class RemoteReplica:
         backoff_seed: int = 0,
         spawn_timeout_s: float = 600.0,
         health_interval_s: float = 0.05,
+        lease_s: float = 0.0,
         python: str = sys.executable,
     ) -> None:
         self.index = int(index)
@@ -303,7 +438,10 @@ class RemoteReplica:
         self._backoff_jitter_frac = float(backoff_jitter_frac)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.health_interval_s = float(health_interval_s)
+        self.lease_s = float(lease_s)
         self._python = python
+        self.attach = str(self.spec.get("attach") or "")
+        self.mode = "attach" if self.attach else "process"
 
         self.registry = MetricsRegistry(
             registry_prefix,
@@ -322,6 +460,14 @@ class RemoteReplica:
             "worker_rpc_latency_seconds",
             "round-trip latency of worker RPC replies",
             buckets=_RPC_BUCKETS,
+        )
+        self._c_lease = self.registry.counter(
+            "lease_expiries_total",
+            "worker leases the router declared expired (no contact)",
+        )
+        self._c_fenced = self.registry.counter(
+            "fenced_frames_total",
+            "stale-generation frames dropped after a fence bump",
         )
 
         self.state = "ejected"
@@ -347,6 +493,17 @@ class RemoteReplica:
         self._rng_lock = threading.Lock()
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+
+        # Fencing + lease state. ``fence`` is this replica's generation
+        # — bumped by the router on eject, stamped by the worker onto
+        # every outbound frame, enforced in _handle_frame. ``_last_ok``
+        # is the lease heartbeat (any successful RPC refreshes it);
+        # ``_lease_fired_gen`` makes expiry fire once per connection.
+        self.fence = 0
+        self._last_ok: Optional[float] = None
+        self._lease_fired_gen = 0
+        self._fence_note_gen = 0
+        self._parted_gate: Optional[_PartitionGate] = None
 
         self.engine: Optional[_RemoteEngine] = None
         # None until first launch so Router.start()'s `rep.loop is None`
@@ -411,56 +568,108 @@ class RemoteReplica:
             return self._teardown_locked(timeout)
 
     def _launch_locked(self, reason: str, hold: bool = False) -> None:
-        spec = {**self.spec, "index": self.index}
-        cmd = [
-            self._python,
-            "-m",
-            "pretraining_llm_tpu.frontend.worker",
-            "--spec-json",
-            json.dumps(spec),
-        ]
-        env = dict(os.environ)
-        env["PYTHONPATH"] = _REPO_ROOT + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-        )
-        proc = subprocess.Popen(
-            cmd,
-            stdin=subprocess.PIPE,   # orphan-detection pipe; never written
-            stdout=subprocess.PIPE,  # handshake line
-            stderr=None,
-            env=env,
-        )
-        try:
-            hs = self._read_handshake(proc)
-            sock = socket.create_connection(
-                ("127.0.0.1", int(hs["port"])), timeout=10.0
-            )
-        except Exception:
+        proc: Optional[subprocess.Popen] = None
+        if self.attach:
+            # Attach mode: the worker is pre-spawned (possibly on
+            # another host) behind --listen/--token. Connect by address
+            # instead of spawning.
+            host, _, port_s = self.attach.rpartition(":")
             try:
-                proc.kill()
-            except OSError:
-                pass
-            raise
+                port = int(port_s)
+                sock = socket.create_connection(
+                    (host or "127.0.0.1", port), timeout=10.0
+                )
+            except (OSError, ValueError) as e:
+                raise ReplicaUnavailable(
+                    f"replica {self.index} attach to {self.attach!r} "
+                    f"failed: {e}"
+                ) from e
+        else:
+            spec = {**self.spec, "index": self.index}
+            cmd = [
+                self._python,
+                "-m",
+                "pretraining_llm_tpu.frontend.worker",
+                "--spec-json",
+                json.dumps(spec),
+            ]
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _REPO_ROOT + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            )
+            proc = subprocess.Popen(
+                cmd,
+                stdin=subprocess.PIPE,   # orphan-detection pipe; never written
+                stdout=subprocess.PIPE,  # handshake line
+                stderr=None,
+                env=env,
+            )
+            try:
+                hs = self._read_handshake(proc)
+                port = int(hs["port"])
+                sock = socket.create_connection(
+                    ("127.0.0.1", port), timeout=10.0
+                )
+            except Exception:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                raise
         sock.settimeout(None)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+        # Every connection is wrapped so partition/wire_delay faults are
+        # injectable on whatever connection is current.
+        gate = _PartitionGate(
+            sock, rng=random.Random(self.index * 7919 + self._conn_gen)
+        )
         with self._conn_lock:
             self._proc = proc
-            self._sock = sock
+            self._sock = gate
             self._conn_gen += 1
             gen = self._conn_gen
         threading.Thread(
             target=self._reader,
-            args=(sock, gen),
+            args=(gate, gen),
             name=f"remote-replica-{self.index}-reader",
             daemon=True,
         ).start()
         # hello blocks until the worker's engine is built (the connect
         # itself only landed in the listen backlog) — so its timeout is
-        # the engine-build budget, not the RPC budget.
-        hello = self._rpc("hello", timeout=self.spawn_timeout_s, retries=0)
+        # the engine-build budget, not the RPC budget. It also grants
+        # the worker its lease term and current fence generation, and
+        # (attach mode) presents the shared token.
+        hello_payload: Dict[str, Any] = {
+            "fence": self.fence,
+            "lease_s": self.lease_s,
+        }
+        token = str(self.spec.get("token") or "")
+        if token:
+            hello_payload["token"] = token
+        hello = self._rpc(
+            "hello", hello_payload, timeout=self.spawn_timeout_s, retries=0
+        )
+        expect = str(self.spec.get("expect_fingerprint") or "")
+        got = str(hello.get("weight_fingerprint") or "")
+        if expect and got != expect:
+            # Wrong weights behind the address: refuse the attach. The
+            # reader's _on_conn_lost goes stale via the gen bump, so
+            # this raises without emitting a spurious conn-lost event.
+            with self._conn_lock:
+                bad, self._sock = self._sock, None
+                self._conn_gen += 1
+            if bad is not None:
+                try:
+                    bad.close()
+                except OSError:
+                    pass
+            raise ReplicaUnavailable(
+                f"replica {self.index} attach refused: worker serves "
+                f"fingerprint {got!r}, expected {expect!r}"
+            )
         self.engine = _RemoteEngine(self, hello)
         if self.loop is None:
             self.loop = _RemoteLoop(self)
@@ -476,11 +685,12 @@ class RemoteReplica:
         self._emit(
             "worker_spawn",
             replica=self.index,
-            pid=int(hs["pid"]),
-            port=int(hs["port"]),
+            pid=int(hello.get("pid", 0)),
+            port=port,
             reason=reason,
             generation=self.generation,
             held=bool(hold),
+            mode=self.mode,
         )
         self._ensure_health_thread()
         # A held launch parks in "draining": the loop accepts submits
@@ -517,6 +727,28 @@ class RemoteReplica:
         return result
 
     def _teardown_locked(self, timeout: float) -> bool:
+        if self.attach:
+            # Detach, never shut down: the pre-spawned worker is not
+            # ours to kill. Closing our end makes its serve loop cancel
+            # in-flight attempts (freeing decode slots + KV) and park
+            # for the next attach — including from a restarted router.
+            with self._conn_lock:
+                sock, self._sock = self._sock, None
+            self._parted_gate = None
+            had_conn = sock is not None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._snapshot = {"running": False}
+            self._fail_pending("worker detached")
+            self._fail_attempts("shutdown: router detached from worker")
+            if had_conn:
+                self._emit(
+                    "worker_detach", replica=self.index, address=self.attach
+                )
+            return True
         clean = True
         proc = self._proc
         if self._connected():
@@ -548,6 +780,7 @@ class RemoteReplica:
         with self._conn_lock:
             sock, self._sock = self._sock, None
             self._proc = None
+        self._parted_gate = None
         if sock is not None:
             try:
                 sock.close()
@@ -571,6 +804,25 @@ class RemoteReplica:
             self._on_conn_lost(gen, str(e) or type(e).__name__)
 
     def _handle_frame(self, frame: Dict[str, Any]) -> None:
+        g = frame.get("g")
+        if g is not None and int(g) < self.fence:
+            # Stale generation: produced before the router last fenced
+            # (ejected) this replica — e.g. tokens decoded behind a
+            # partition that has since healed. The requests they belong
+            # to were redriven onto survivors; delivering them would
+            # duplicate tokens. Drop and count.
+            self._c_fenced.inc()
+            with self._conn_lock:
+                gen = self._conn_gen
+            if self._fence_note_gen != gen:
+                self._fence_note_gen = gen
+                self._emit(
+                    "fenced_frames_dropped",
+                    replica=self.index,
+                    fence=self.fence,
+                    stale_generation=int(g),
+                )
+            return
         if "id" in frame:
             with self._pending_lock:
                 q = self._pending.get(frame["id"])
@@ -667,6 +919,7 @@ class RemoteReplica:
         *,
         timeout: Optional[float] = None,
         retries: Optional[int] = None,
+        conn_lost_on_timeout: bool = True,
     ) -> Any:
         timeout = self.rpc_timeout_s if timeout is None else timeout
         retries = self.rpc_retries if retries is None else retries
@@ -703,9 +956,15 @@ class RemoteReplica:
             except queue.Empty:
                 self._c_timeouts.inc()
                 if k >= retries:
-                    self._on_conn_lost(
-                        gen, f"rpc {op} timed out after {timeout}s"
-                    )
+                    # Lease-mode health polls pass conn_lost_on_timeout=
+                    # False: a timeout there is lease evidence, not a
+                    # verdict — tearing the socket down would destroy
+                    # the stale-frame backlog a healed partition must
+                    # deliver (and be counted against).
+                    if conn_lost_on_timeout:
+                        self._on_conn_lost(
+                            gen, f"rpc {op} timed out after {timeout}s"
+                        )
                     raise ReplicaUnavailable(
                         f"replica {self.index} rpc {op} timed out "
                         f"after {timeout}s"
@@ -715,6 +974,7 @@ class RemoteReplica:
                 with self._pending_lock:
                     self._pending.pop(rid, None)
             self._h_rpc.observe(time.monotonic() - t0)
+            self._last_ok = time.monotonic()
             if "ok" in reply:
                 return reply["ok"]
             kind = reply.get("error", "runtime")
@@ -862,6 +1122,88 @@ class RemoteReplica:
                         sock.shutdown(socket.SHUT_RDWR)
                     except OSError:
                         pass
+            elif kind == "partition":
+                self.partition()
+            elif kind == "wire_delay":
+                self.set_wire_delay(_WIRE_DELAY_S, jitter_frac=0.5)
+
+    # -- partition / fencing / lease surface --------------------------
+
+    def partition(self) -> None:
+        """Blackhole the live connection: reads hang, writes buffer —
+        no RST, no EOF (unlike ``conn_drop``). Detection is therefore
+        the lease machinery, never the socket."""
+        with self._conn_lock:
+            gate = self._sock
+        if gate is None:
+            return
+        # Remember which gate was partitioned: a relaunch swaps _sock
+        # for a fresh connection, but heal() must still heal THIS one.
+        self._parted_gate = gate
+        gate.partition()
+        self._emit("partition_injected", replica=self.index)
+
+    def heal(self) -> None:
+        """Heal the (most recently) partitioned connection: buffered
+        writes flush, and the backlog the worker streamed into the void
+        becomes readable — the stale-generation flood the fence filter
+        exists to drop."""
+        gate, self._parted_gate = self._parted_gate, None
+        if gate is None:
+            with self._conn_lock:
+                gate = self._sock
+        if gate is None:
+            return
+        gate.heal()
+        self._emit("partition_healed", replica=self.index)
+
+    def set_wire_delay(
+        self, delay_s: float, jitter_frac: float = 0.0
+    ) -> None:
+        """Add one-way delay (+ jitter) to every recv on the current
+        connection — a slow WAN link, injectable distinctly from a full
+        partition."""
+        with self._conn_lock:
+            gate = self._sock
+        if gate is None:
+            return
+        gate.set_delay(delay_s, jitter_frac)
+        self._emit(
+            "wire_delay_set",
+            replica=self.index,
+            delay_s=float(delay_s),
+            jitter_frac=float(jitter_frac),
+        )
+
+    def bump_fence(self, reason: str) -> int:
+        """Advance this replica's fence generation (router calls this
+        on eject). Every frame the worker produced under the old
+        generation — including everything buffered behind a partition —
+        is dropped on arrival from now on."""
+        self.fence += 1
+        self._emit(
+            "fence_bump", replica=self.index, fence=self.fence, reason=reason
+        )
+        return self.fence
+
+    def sever(self) -> None:
+        """Abrupt, event-free disconnect — the router-crash simulation.
+        No shutdown RPC, no attempt terminals, no events: exactly what
+        the worker observes when the router process dies mid-flight.
+        The worker itself survives (attach mode: its lease expires and
+        it parks; a restarted router re-attaches)."""
+        with self._conn_lock:
+            sock, self._sock = self._sock, None
+            # Make the reader's _on_conn_lost stale so the close below
+            # stays silent (no failure snapshot, no conn-lost event).
+            self._conn_gen += 1
+        self._parted_gate = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._snapshot = {"running": False}
 
     def debug_snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -870,9 +1212,12 @@ class RemoteReplica:
             "generation": self.generation,
             "submits": self.submits,
             "alive": self.alive,
-            "mode": "process",
+            "mode": self.mode,
+            "fence": self.fence,
             "pid": self._proc.pid if self._proc is not None else None,
         }
+        if self.attach:
+            out["attach"] = self.attach
         loop = self.loop
         if loop is not None:
             out["draining"] = loop.draining
@@ -900,12 +1245,65 @@ class RemoteReplica:
         while not stop.wait(self.health_interval_s):
             if not self._connected():
                 continue
-            try:
-                snap = self._rpc("health", timeout=self.rpc_timeout_s)
-            except Exception:
-                continue  # conn-lost path already updated the snapshot
+            lease = self.lease_s
+            # Health polls double as the lease heartbeat: each carries
+            # the current fence generation + lease term the worker
+            # should honor (the hello only covers connect time; fence
+            # bumps between ejects arrive this way).
+            hb = {"fence": self.fence, "lease_s": lease}
+            if lease > 0:
+                with self._conn_lock:
+                    gen = self._conn_gen
+                if self._lease_fired_gen == gen:
+                    # Lease already expired on this connection: stop
+                    # heartbeating into the void; the router's backoff
+                    # relaunch (detach + reconnect) resumes polling.
+                    continue
+                try:
+                    snap = self._rpc(
+                        "health",
+                        hb,
+                        timeout=min(
+                            self.rpc_timeout_s, max(0.05, lease / 4.0)
+                        ),
+                        retries=0,
+                        conn_lost_on_timeout=False,
+                    )
+                except Exception:
+                    self._maybe_expire_lease(gen)
+                    continue
+            else:
+                try:
+                    snap = self._rpc("health", hb, timeout=self.rpc_timeout_s)
+                except Exception:
+                    continue  # conn-lost path already updated the snapshot
             snap["t"] = self._clock()
             self._snapshot = snap
+
+    def _maybe_expire_lease(self, gen: int) -> None:
+        """Declare the lease expired if no RPC has succeeded for a full
+        lease term. Fails live attempts with the redrivable ``engine
+        failure`` prefix but deliberately does NOT close the socket:
+        when the partition heals, the frames the worker streamed into
+        the void must still arrive — stamped with a stale generation —
+        to be counted and dropped by the fence filter."""
+        lease = self.lease_s
+        last = self._last_ok
+        if lease <= 0 or last is None:
+            return
+        age = time.monotonic() - last
+        if age <= lease:
+            return
+        if self._lease_fired_gen == gen:
+            return
+        self._lease_fired_gen = gen
+        self._c_lease.inc()
+        reason = f"worker lease expired (no contact for {age:.2f}s)"
+        self._snapshot = {"running": False, "failure": reason}
+        self._fail_attempts(f"engine failure: {reason}")
+        self._emit(
+            "lease_expired", replica=self.index, age_s=round(age, 3)
+        )
 
     def _set_state(self, state: str, reason: str) -> None:
         assert state in REPLICA_STATES, state

@@ -64,6 +64,7 @@ from pretraining_llm_tpu.frontend.engine_loop import (
     TERMINAL_STATUSES,
     FrontendRequest,
 )
+from pretraining_llm_tpu.frontend.journal import FleetJournal
 from pretraining_llm_tpu.frontend.replica import (
     REPLICA_STATE_VALUES,
     Replica,
@@ -183,9 +184,13 @@ class Router:
         probe_max_new: int = 4,
         probe_timeout_s: float = 30.0,
         probe_set: Optional[List[Any]] = None,
+        journal_path: str = "",
+        recover: bool = False,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
+        if recover and not journal_path:
+            raise ValueError("recover=True needs a journal_path")
         if affinity_tokens < 1:
             raise ValueError(
                 f"affinity_tokens must be >= 1, got {affinity_tokens}"
@@ -274,12 +279,14 @@ class Router:
             "errors": 0, "redrives": 0, "brownout_shed": 0, "ejects": 0,
             "probes": 0, "probe_failures": 0, "quarantines": 0,
             "relaunches": 0, "upgrades": 0, "upgrades_refused": 0,
+            "journal_replays": 0,
         }
         self._g_state: Dict[int, Any] = {}
         self._g_backoff: Dict[int, Any] = {}
         self._c_redrives = self._c_shed = self._c_ejects = None
         self._c_probes = self._c_probe_fail = self._c_quarantines = None
         self._c_relaunches = None
+        self._c_replays = None
         self._g_brownout = None
         if registry is not None:
             for rep in self.replicas:
@@ -318,6 +325,35 @@ class Router:
             self._c_quarantines = registry.counter(
                 "quarantines_total",
                 "replicas quarantined by the integrity sentinel")
+            self._c_replays = registry.counter(
+                "router_journal_replays_total",
+                "journaled in-flight requests redriven by a recovering "
+                "router")
+        # Write-ahead fleet journal (crash-recoverable control plane).
+        # With recover=True the previous router's journal is folded into
+        # a recovery plan BEFORE this router touches any worker: fence
+        # generations advance past everything the dead router granted,
+        # so every frame its workers still hold in flight is stale by
+        # construction, and frids continue past the old allocator.
+        self.journal: Optional[FleetJournal] = None
+        self.recovered: Dict[int, RouterRequest] = {}
+        self._recover_plan: Optional[Dict[str, Any]] = None
+        if journal_path:
+            if recover:
+                plan = FleetJournal.recovery_plan(
+                    FleetJournal.load(journal_path)
+                )
+                self._recover_plan = plan
+                self._next_frid = max(
+                    self._next_frid, int(plan["next_frid"])
+                )
+                for rep in self.replicas:
+                    if hasattr(rep, "fence"):
+                        rep.fence = max(
+                            rep.fence,
+                            int(plan["fences"].get(rep.index, 0)) + 1,
+                        )
+            self.journal = FleetJournal(journal_path)
         for rep in self.replicas:
             rep.on_state = self._on_replica_state
 
@@ -401,11 +437,89 @@ class Router:
                     self._pin_serving_baseline(self._probe_set),
                 )
             ]
+        if self.journal is not None:
+            # Membership + fence baseline first, so even a journal with
+            # zero requests lets the next recovery fence everything.
+            for rep in self.replicas:
+                self.journal.append({
+                    "rec": "member",
+                    "replica": rep.index,
+                    "mode": getattr(rep, "mode", "inproc"),
+                    "attach": getattr(rep, "attach", ""),
+                    "generation": rep.generation,
+                })
+                self.journal.append({
+                    "rec": "fence",
+                    "replica": rep.index,
+                    "fence": int(getattr(rep, "fence", 0)),
+                })
+        # Replay journaled in-flight requests BEFORE the health thread
+        # starts interleaving ejects: the replicas are launched and
+        # idle, so every replay places deterministically.
+        self._replay_journal()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="router-health", daemon=True
         )
         self._health_thread.start()
         return self
+
+    def _replay_journal(self) -> None:
+        """Redrive every journaled in-flight request from its last
+        committed frontier (recover=True). Replays bypass fleet
+        admission — they were admitted by the previous router and their
+        tickets died with it; re-gating them could deadlock recovery
+        behind fresh traffic. Deadlines are not resurrected (they were
+        absolute on the dead router's clock). Greedy decode from
+        ``prompt + tokens`` makes each completion bit-identical to the
+        undisturbed output."""
+        plan = self._recover_plan
+        if not plan or not plan["live"]:
+            return
+        for frid in sorted(plan["live"]):
+            ent = plan["live"][frid]
+            rreq = RouterRequest(
+                int(frid), list(ent["prompt"]), int(ent["max_new"]),
+                deadline=None, submitted_s=self._clock(),
+                priority=int(ent["priority"]),
+            )
+            rreq.tokens = list(ent["tokens"])
+            rreq.redrives = int(ent["redrives"])
+            self.recovered[rreq.frid] = rreq
+            with self._live_lock:
+                self._live[rreq.frid] = rreq
+            with self._counters_lock:
+                self.counters["submitted"] += 1
+                self.counters["journal_replays"] += 1
+            if self._c_replays is not None:
+                self._c_replays.inc()
+            if self.bus is not None:
+                self.bus.emit(
+                    "fleet_req_submit", frid=rreq.frid, replica=None,
+                    n_prompt=len(rreq.prompt), max_new=rreq.max_new,
+                    priority=rreq.priority, replayed=True,
+                )
+            replica: Optional[int] = None
+            with rreq._lock:
+                if len(rreq.tokens) >= rreq.max_new:
+                    # The journal frontier already covers the whole
+                    # greedy output: the old router died between the
+                    # last commit and its terminal bookkeeping.
+                    self._finish_locked(
+                        rreq, "done", {"completed_at_replay": True}
+                    )
+                else:
+                    try:
+                        replica = self._assign_locked(rreq, exclude=set())
+                    except Exception as e:
+                        self._finish_locked(
+                            rreq, "error",
+                            {"reason": f"journal replay failed: {e}"},
+                        )
+            if self.bus is not None:
+                self.bus.emit(
+                    "journal_replay", frid=rreq.frid, replica=replica,
+                    n_committed=len(rreq.tokens), redrives=rreq.redrives,
+                )
 
     def stop(self, timeout: float = 10.0) -> bool:
         """Stop the fleet. In-flight requests get error terminals (via
@@ -425,7 +539,28 @@ class Router:
         for rreq in self._live_snapshot():
             with rreq._lock:
                 self._finish_locked(rreq, "error", {"reason": "router shutdown"})
+        if self.journal is not None:
+            self.journal.close()
         return clean
+
+    def abort(self) -> None:
+        """Simulate a router CRASH (the recovery drill's kill switch):
+        no shutdown RPCs, no request terminals, no events — workers and
+        clients are simply cut off, exactly as if the process died.
+        Attached workers' leases expire and they park; a new Router
+        built with ``recover=True`` on the same journal re-attaches,
+        fences the old generation, and redrives the journaled work."""
+        self._stopping = True
+        self._stop_ev.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
+        for rep in self.replicas:
+            sever = getattr(rep, "sever", None)
+            if sever is not None:
+                sever()
+        if self.journal is not None:
+            self.journal.close()
 
     def __enter__(self) -> "Router":
         return self.start()
@@ -511,6 +646,16 @@ class Router:
         with self._live_lock:
             frid = self._next_frid
             self._next_frid += 1
+        if self.journal is not None:
+            # Write-AHEAD of placement: a router that dies between this
+            # record and the replica ack still redrives the request on
+            # recovery (at-least-once into the fleet; the fence makes
+            # delivery to the client at-most-once per generation).
+            self.journal.append({
+                "rec": "submit", "frid": frid, "prompt": prompt,
+                "max_new": max_new, "priority": int(priority),
+                "deadline_s": deadline_s,
+            })
         rreq = RouterRequest(
             frid, prompt, max_new,
             deadline=(now + deadline_s) if deadline_s is not None else None,
@@ -523,6 +668,12 @@ class Router:
         except BaseException:
             if ticket is not None:
                 self.admission.release(ticket)
+            if self.journal is not None:
+                # The client saw the rejection; recovery must not
+                # resurrect it.
+                self.journal.append(
+                    {"rec": "terminal", "frid": frid, "status": "rejected"}
+                )
             raise
         with self._live_lock:
             self._live[frid] = rreq
@@ -751,6 +902,14 @@ class Router:
                 {"reason": f"redrive failed: {e}", "redrive_from": from_idx},
             )
             return True
+        if self.journal is not None:
+            # The committed frontier at the moment of failover — token
+            # VALUES, not a count, so a recovering router can re-submit
+            # ``prompt + tokens`` and greedy-decode the identical tail.
+            self.journal.append({
+                "rec": "frontier", "frid": rreq.frid,
+                "tokens": list(rreq.tokens), "redrives": rreq.redrives,
+            })
         with self._counters_lock:
             self.counters["redrives"] += 1
         if self._c_redrives is not None:
@@ -776,6 +935,10 @@ class Router:
         if rreq.status in TERMINAL_STATUSES:
             return
         rreq.status = status
+        if self.journal is not None:
+            self.journal.append(
+                {"rec": "terminal", "frid": rreq.frid, "status": status}
+            )
         info = dict(info)
         info["redrives"] = rreq.redrives
         info["n_tokens"] = len(rreq.tokens)
@@ -868,6 +1031,17 @@ class Router:
             "eject_replica", replica=rep.index, reason=reason,
             generation=rep.generation,
         )
+        # Fence BEFORE redriving: from this point every frame the
+        # ejected worker already produced (or will produce behind a
+        # partition) is stale — the redriven copies on survivors own
+        # the streams, so partition-then-heal cannot double-serve.
+        bump = getattr(rep, "bump_fence", None)
+        if bump is not None:
+            fence = bump(reason)
+            if self.journal is not None:
+                self.journal.append(
+                    {"rec": "fence", "replica": rep.index, "fence": fence}
+                )
         backoff = self._next_backoff(rep.index)
         self._relaunch_at[rep.index] = self._clock() + backoff
         self._redrive_from(rep.index, reason)

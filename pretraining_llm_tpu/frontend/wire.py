@@ -18,15 +18,30 @@ two must not be conflated because only the first is redrivable.
 from __future__ import annotations
 
 import json
+import select
 import socket
 import struct
-from typing import Any, Dict
+import time
+from typing import Any, Dict, Optional
 
 # A frame is one JSON op or one token batch — 64 MiB means a corrupt
 # length prefix fails fast instead of attempting a multi-GB recv.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
+# A peer that will not drain one frame's worth of bytes in this long is
+# as gone as one that sent RST: its kernel buffer is full and nothing
+# is reading (blackholed route, wedged process). Sends past the
+# deadline raise ConnectionLost so the slow-peer case converges on the
+# same redrive path as outright death.
+SEND_DEADLINE_S = 30.0
+
 _LEN = struct.Struct(">I")
+
+# Per-call non-blocking send (Linux): the socket itself must stay
+# blocking — it is shared with a reader thread, and both settimeout and
+# setblocking are socket-wide. Elsewhere the flag degrades to 0 and the
+# send falls back to kernel blocking semantics.
+_MSG_DONTWAIT = getattr(socket, "MSG_DONTWAIT", 0)
 
 
 class ConnectionLost(Exception):
@@ -61,11 +76,55 @@ def encode_frame(payload: Dict[str, Any]) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
-def send_frame(sock: socket.socket, payload: Dict[str, Any]) -> None:
-    """Send one frame; any OS-level send failure means the peer died."""
+def send_frame(
+    sock: socket.socket,
+    payload: Dict[str, Any],
+    deadline_s: Optional[float] = SEND_DEADLINE_S,
+) -> None:
+    """Send one frame; any OS-level send failure means the peer died.
+
+    The send loop is explicit over ``sendall`` boundaries: each pass
+    waits (via select) for the socket to accept bytes, bounded by a
+    per-FRAME deadline, then writes one partial chunk with
+    ``MSG_DONTWAIT`` — select only promises SOME buffer space, and a
+    plain blocking ``send`` of the large remainder would sleep in the
+    kernel until ALL of it fit, hanging the caller exactly like the
+    ``sendall`` this loop replaces. A peer that stops draining (full
+    kernel buffer behind a blackholed route) therefore surfaces as
+    ``ConnectionLost`` within ``deadline_s``. select is used rather
+    than ``settimeout``/``setblocking`` because the socket is shared
+    with a reader thread and both are socket-wide.
+    """
     data = encode_frame(payload)
+    deadline = (
+        time.monotonic() + deadline_s if deadline_s is not None else None
+    )
+    sent = 0
     try:
-        sock.sendall(data)
+        while sent < len(data):
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionLost(
+                        f"send deadline exceeded: peer accepted only "
+                        f"{sent}/{len(data)} bytes in {deadline_s}s"
+                    )
+                _, writable, _ = select.select([], [sock], [], remaining)
+            else:
+                _, writable, _ = select.select([], [sock], [])
+            if not writable:
+                raise ConnectionLost(
+                    f"send deadline exceeded: peer accepted only "
+                    f"{sent}/{len(data)} bytes in {deadline_s}s"
+                )
+            try:
+                n = sock.send(data[sent:], _MSG_DONTWAIT)
+            except BlockingIOError:
+                # The buffer filled between select and send; wait again.
+                continue
+            if n == 0:
+                raise ConnectionLost("send returned 0 bytes: peer gone")
+            sent += n
     except (OSError, ValueError) as e:  # ValueError: fd closed under us
         raise ConnectionLost(f"send failed: {e}") from e
 

@@ -54,6 +54,26 @@ Robustness hooks baked into the worker itself:
   died, so the worker drains, waits briefly for in-flight work, and
   exits — killed routers never leak workers. SIGTERM takes the same
   path.
+- multi-host attach mode: ``--listen host:port --token <secret>``
+  serves a PRE-SPAWNED worker over TCP. The router connects by address
+  instead of spawning; the first frame on every connection must be a
+  ``hello`` carrying the shared token (the reply carries the engine
+  weight fingerprint, so the router can refuse a worker serving the
+  wrong weights). There is no stdin pipe to watch, so the orphan watch
+  is replaced by a **heartbeat lease**: every frame from the router
+  (health polls are the heartbeat carrier) refreshes the lease; if the
+  router is unreachable for the ``lease_s`` the hello granted, the
+  worker stops admitting, cancels its in-flight work (the router has
+  redriven it elsewhere by now — serving it further risks double
+  serve), and PARKS listening for the next attach instead of exiting.
+- fencing: the hello (and every health heartbeat) carries the router's
+  monotonically increasing fence generation for this replica; the
+  worker stamps the generation it held AT SUBMIT TIME onto every
+  stream frame (``"g"``) and the current generation onto replies and
+  events. After a partition-then-heal, frames from before the router
+  ejected this replica carry a stale generation and the parent drops
+  them — a healed worker can never stream duplicate tokens into a
+  request a survivor already answered.
 - ``kill_after_submits: N`` in the spec: SIGKILL *itself* right after
   acknowledging the Nth wire submit (either lane) — this is how the
   mid-upgrade-kill drill crashes the upgrading worker inside its
@@ -178,11 +198,34 @@ class WorkerServer:
         self._conn: Optional[socket.socket] = None
         self._wlock = threading.Lock()
         self._event_buf: list = []
+        # wrid -> (attempt, fence generation held when it was submitted):
+        # stream frames carry the SUBMIT-time generation, so work from
+        # before an eject stays distinguishable after a heal/re-attach.
         self._attempts: Dict[int, Any] = {}
         self.replica = None  # set in start_replica()
 
-        host = str(spec.get("host", "127.0.0.1"))
-        self._listener = socket.create_server((host, 0))
+        # Fencing + lease state (attach mode; inert for spawned children
+        # until a hello grants a lease).
+        self._token = str(spec.get("token") or "")
+        self._fence = 0
+        self._lease_s = 0.0
+        self._last_contact = time.monotonic()
+        self._lease_expiries = 0
+        self.attached = bool(spec.get("listen"))
+
+        listen = str(spec.get("listen") or "")
+        if listen:
+            host, _, port_s = listen.rpartition(":")
+            if not port_s:
+                raise ValueError(
+                    f"--listen must be host:port, got {listen!r}"
+                )
+            self._listener = socket.create_server(
+                (host or "127.0.0.1", int(port_s))
+            )
+        else:
+            host = str(spec.get("host", "127.0.0.1"))
+            self._listener = socket.create_server((host, 0))
         self._listener.listen(4)
         self.port = int(self._listener.getsockname()[1])
 
@@ -240,6 +283,46 @@ class WorkerServer:
             pass
         self._drain_and_exit("orphaned (parent pipe closed)")
 
+    def start_lease_watch(self) -> None:
+        threading.Thread(
+            target=self._watch_lease, name="worker-lease", daemon=True
+        ).start()
+
+    def _watch_lease(self) -> None:
+        """Attach-mode replacement for the orphan watch: a router that
+        stays unreachable for a full lease term has either died or
+        already redriven our work onto survivors — keep serving it and
+        a heal would double-serve. Expire the lease: drop the
+        connection (the serve loop cancels every in-flight attempt,
+        freeing decode slots and KV) and park listening for the next
+        attach instead of exiting."""
+        while not self._shutdown.wait(0.05):
+            lease = self._lease_s
+            if lease <= 0:
+                continue
+            with self._wlock:
+                conn = self._conn
+            if conn is None:
+                continue
+            age = time.monotonic() - self._last_contact
+            if age <= lease:
+                continue
+            self._lease_expiries += 1
+            sys.stderr.write(
+                f"[worker {self.index}] lease expired (router silent "
+                f"{age:.2f}s > lease {lease}s); draining and parking\n"
+            )
+            sys.stderr.flush()
+            with self._wlock:
+                if self._conn is conn:
+                    self._conn = None
+            try:
+                # Wakes _serve_conn's blocking recv: its teardown path
+                # cancels the attempts and returns to the accept loop.
+                conn.close()
+            except OSError:
+                pass
+
     def _drain_and_exit(self, reason: str) -> None:
         try:
             sys.stderr.write(f"[worker {self.index}] {reason}; draining\n")
@@ -259,7 +342,13 @@ class WorkerServer:
 
     # ---- wire output (single writer lock; drop when unconnected) ----
 
-    def _send(self, payload: Dict[str, Any]) -> None:
+    def _send(self, payload: Dict[str, Any], g: Optional[int] = None) -> None:
+        # Every outbound frame is stamped with a fence generation; the
+        # parent drops (and counts) frames whose generation predates its
+        # last eject of this replica. Stream frames pass the SUBMIT-time
+        # generation; everything else carries the current one.
+        payload = dict(payload)
+        payload["g"] = self._fence if g is None else g
         with self._wlock:
             conn = self._conn
             if conn is None:
@@ -270,7 +359,10 @@ class WorkerServer:
                 pass  # reader side notices and tears the connection down
 
     def send_event(self, kind: str, step: int, fields: Dict[str, Any]) -> None:
-        frame = {"op": "event", "kind": kind, "step": step, "fields": fields}
+        frame = {
+            "op": "event", "kind": kind, "step": step, "fields": fields,
+            "g": self._fence,
+        }
         with self._wlock:
             conn = self._conn
             if conn is None:
@@ -295,6 +387,7 @@ class WorkerServer:
                 buffered, self._event_buf = self._event_buf, []
             for frame in buffered:
                 self._send(frame)
+            self._last_contact = time.monotonic()
             try:
                 self._serve_conn(conn)
             except (ConnectionLost, ProtocolError):
@@ -311,15 +404,17 @@ class WorkerServer:
                 # slots and KV blocks free up before any reconnect.
                 loop = self.replica.loop if self.replica else None
                 if loop is not None:
-                    for attempt in list(self._attempts.values()):
+                    for attempt, _g in list(self._attempts.values()):
                         try:
                             loop.cancel(attempt)
                         except Exception:
                             pass
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        authed = not self._token
         while not self._shutdown.is_set():
             req = recv_frame(conn)
+            self._last_contact = time.monotonic()
             op = str(req.get("op", ""))
             if op == "stall":
                 # Fault drill: go silent without dying. Stop reading so
@@ -328,6 +423,20 @@ class WorkerServer:
                     pass
                 return
             rid = req.get("id")
+            if not authed:
+                # Attach handshake: the FIRST frame must be a hello
+                # presenting the shared token — anyone can reach a
+                # listening TCP port; only the router holds the secret.
+                if op != "hello" or str(req.get("token") or "") != self._token:
+                    self._send(
+                        {
+                            "id": rid,
+                            "error": "unauthorized",
+                            "message": "bad or missing attach token",
+                        }
+                    )
+                    return
+                authed = True
             try:
                 handled = self._dispatch(op, req)
             except Exception as e:  # handler bug: report, keep serving
@@ -349,6 +458,7 @@ class WorkerServer:
         rep = self.replica
         loop = rep.loop
         if op == "hello":
+            self._adopt_lease(req)
             eng = loop.engine
             self._send(
                 {
@@ -363,6 +473,15 @@ class WorkerServer:
                         "n_blocks": int(eng.alloc.n_blocks),
                         "max_batch": int(eng.max_batch),
                         "temperature": float(eng.temperature),
+                        # Attach handshake extras: the engine fingerprint
+                        # lets the router refuse a worker serving the
+                        # wrong weights; the echoed fence/lease confirm
+                        # what this worker will stamp and honor.
+                        "weight_fingerprint0": loop.weight_fingerprint0,
+                        "weight_fingerprint": loop.weight_fingerprint,
+                        "fence": self._fence,
+                        "lease_s": self._lease_s,
+                        "lease_expiries": self._lease_expiries,
                     },
                 }
             )
@@ -371,9 +490,9 @@ class WorkerServer:
             self._handle_submit(rid, req)
             return True
         if op == "cancel":
-            attempt = self._attempts.get(int(req.get("rid", -1)))
-            if attempt is not None:
-                loop.cancel(attempt)
+            ent = self._attempts.get(int(req.get("rid", -1)))
+            if ent is not None:
+                loop.cancel(ent[0])
             self._send({"id": rid, "ok": True})
             return True
         if op == "drain":
@@ -381,6 +500,9 @@ class WorkerServer:
             self._send({"id": rid, "ok": True})
             return True
         if op == "health":
+            # Health polls double as the lease heartbeat: each carries
+            # the router's current fence generation + lease term.
+            self._adopt_lease(req)
             self._send({"id": rid, "ok": self._health()})
             return True
         if op == "metrics":
@@ -458,11 +580,12 @@ class WorkerServer:
             self._send({"id": rid, "error": "unavailable", "message": str(e)})
             return
         self._wire_submits += 1
-        self._attempts[wrid] = attempt
+        g = self._fence
+        self._attempts[wrid] = (attempt, g)
         self._send({"id": rid, "ok": {"rid": wrid}})
         threading.Thread(
             target=self._pump,
-            args=(wrid, attempt),
+            args=(wrid, attempt, g),
             name=f"worker-pump-{wrid}",
             daemon=True,
         ).start()
@@ -471,21 +594,32 @@ class WorkerServer:
             # the parent is committed to waiting on this stream.
             os.kill(os.getpid(), signal.SIGKILL)
 
-    def _pump(self, wrid: int, attempt: Any) -> None:
+    def _pump(self, wrid: int, attempt: Any, g: int) -> None:
         try:
             for ev in attempt.events():
                 if ev[0] == "token":
-                    self._send({"token": wrid, "t": int(ev[1])})
+                    self._send({"token": wrid, "t": int(ev[1])}, g=g)
                 elif ev[0] == "end":
                     self._send(
                         {
                             "end": wrid,
                             "status": attempt.status,
                             "info": dict(attempt.info),
-                        }
+                        },
+                        g=g,
                     )
         finally:
             self._attempts.pop(wrid, None)
+
+    def _adopt_lease(self, req: Dict[str, Any]) -> None:
+        fence = req.get("fence")
+        if fence is not None:
+            # Monotonic: a delayed heartbeat from before an eject must
+            # not roll the generation back.
+            self._fence = max(self._fence, int(fence))
+        lease_s = req.get("lease_s")
+        if lease_s is not None:
+            self._lease_s = max(0.0, float(lease_s))
 
     def _handle_probe_set(self, rid: Any, req: Dict[str, Any]) -> None:
         try:
@@ -529,6 +663,8 @@ class WorkerServer:
             "failure": repr(failure) if failure is not None else None,
             "weight_fingerprint0": loop.weight_fingerprint0,
             "weight_fingerprint": loop.weight_fingerprint,
+            "lease_expiries": self._lease_expiries,
+            "fence": self._fence,
         }
 
     def _exit_clean(self) -> None:
@@ -551,10 +687,27 @@ def main(argv=None) -> int:
         required=True,
         help="worker spec as a JSON object (see module docstring)",
     )
+    parser.add_argument(
+        "--listen",
+        default="",
+        help="host:port to serve on as a PRE-SPAWNED multi-host worker "
+        "(port 0 binds an ephemeral port, announced on stdout); the "
+        "router attaches by address instead of spawning this process",
+    )
+    parser.add_argument(
+        "--token",
+        default="",
+        help="shared secret every attaching router must present in its "
+        "hello (attach mode)",
+    )
     args = parser.parse_args(argv)
     spec = json.loads(args.spec_json)
     if not isinstance(spec, dict):
         raise SystemExit("--spec-json must be a JSON object")
+    if args.listen:
+        spec["listen"] = args.listen
+    if args.token:
+        spec["token"] = args.token
 
     server = WorkerServer(spec)
     server.announce()
@@ -566,7 +719,13 @@ def main(argv=None) -> int:
             daemon=True,
         ).start(),
     )
-    server.start_orphan_watch()
+    if server.attached:
+        # Pre-spawned workers have no parent pipe; the heartbeat lease
+        # (granted by the attaching router's hello) replaces the orphan
+        # watch — expiry parks the worker instead of exiting it.
+        server.start_lease_watch()
+    else:
+        server.start_orphan_watch()
     server.start_replica()
     server.serve_forever()
     return 0

@@ -63,10 +63,16 @@ SERVING_FAULT_KINDS = (
     "worker_kill",    # SIGKILL the worker process (hard crash, no cleanup)
     "worker_stall",   # worker stops reading frames but stays alive
     "conn_drop",      # sever the parent<->worker socket; both ends survive
+    "partition",      # blackhole the socket: reads hang, writes buffer —
+                      # no RST/EOF, so only leases + fencing can detect it
+                      # (heal via FleetAction kind="heal" or replica.heal())
+    "wire_delay",     # add per-recv delay + jitter (slow WAN link drill)
 )
 
 # The subset above that needs a process boundary to mean anything.
-PROCESS_SERVING_FAULT_KINDS = ("worker_kill", "worker_stall", "conn_drop")
+PROCESS_SERVING_FAULT_KINDS = (
+    "worker_kill", "worker_stall", "conn_drop", "partition", "wire_delay",
+)
 
 # How long an injected hang blocks the host loop. Effectively forever next to
 # any sane watchdog timeout; bounded so a test run without a watchdog still

@@ -14,6 +14,7 @@ JAX_PLATFORMS=cpu python -m pytest \
     tests/test_observability.py \
     tests/test_integrity.py \
     tests/test_process_fleet.py \
+    tests/test_multihost_fleet.py \
     "tests/test_training.py::test_checkpoint_roundtrip_and_exact_resume" \
     "tests/test_training.py::test_checkpoint_retention" \
     "tests/test_training.py::test_checkpoint_sharded_leaf_reassembly" \
@@ -624,6 +625,149 @@ grep -q "lost=0" "$OBS_TMP/proc_fleet_report.out" || {
     echo "obs_report --fleet (process) did not report lost=0"; exit 1; }
 grep -q "worker death" "$OBS_TMP/proc_fleet_report.out" || {
     echo "obs_report --fleet missing the worker death join"; exit 1; }
+
+# Multi-host gate: two PRE-SPAWNED workers serving on localhost TCP
+# (the router does not own their lifecycle — it attaches by address with
+# a shared token, exactly the cross-host deployment shape). Replica 0 is
+# blackholed mid-burst: its reads hang and its writes buffer, which is a
+# PARTITION, not a connection drop. The router must detect it via lease
+# expiry, bump the fence generation, and redrive onto the survivor with
+# zero lost requests; on heal, the frames the partitioned worker kept
+# streaming (stamped with the old generation) must be counted and
+# DROPPED — never forwarded as duplicate tokens. Workers must survive
+# router detach (they are not the router's children).
+MH_SPEC='{"preset":"tiny","init_seed":0,"model_overrides":{"compute_dtype":"float32"},"engine":{"max_batch":2,"n_blocks":24,"block_size":8,"temperature":0.0,"steps_per_sched":4,"pipeline_depth":2},"admission":{"max_queue_depth":8}}'
+JAX_PLATFORMS=cpu python -m pretraining_llm_tpu.frontend.worker \
+    --spec-json "$MH_SPEC" --listen 127.0.0.1:0 --token mh-smoke-token \
+    > "$OBS_TMP/mh_worker0.out" 2> "$OBS_TMP/mh_worker0.err" &
+MH_W0=$!
+JAX_PLATFORMS=cpu python -m pretraining_llm_tpu.frontend.worker \
+    --spec-json "$MH_SPEC" --listen 127.0.0.1:0 --token mh-smoke-token \
+    > "$OBS_TMP/mh_worker1.out" 2> "$OBS_TMP/mh_worker1.err" &
+MH_W1=$!
+
+mh_port() {  # wait for the worker's one-line stdout announce, echo port
+    local out="$1" port="" i
+    for i in $(seq 1 360); do
+        if [ -s "$out" ]; then
+            port=$(head -n 1 "$out" | python -c 'import json,sys; print(json.loads(sys.stdin.readline())["worker"]["port"])' 2>/dev/null) && \
+                [ -n "$port" ] && break
+            port=""
+        fi
+        sleep 0.5
+    done
+    if [ -z "$port" ]; then
+        echo "listen worker never announced a port ($out):" >&2
+        cat "${out%.out}.err" >&2
+        return 1
+    fi
+    echo "$port"
+}
+MH_ADDR0="127.0.0.1:$(mh_port "$OBS_TMP/mh_worker0.out")"
+MH_ADDR1="127.0.0.1:$(mh_port "$OBS_TMP/mh_worker1.out")"
+
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" MH_ADDR0="$MH_ADDR0" \
+    MH_ADDR1="$MH_ADDR1" python - <<'EOF'
+import json, os, time, urllib.request
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+bus = EventBus(os.path.join(tmp, "mh_events.jsonl"))
+faults = ServingFaultInjector("partition@req2:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_")
+spec = {
+    "preset": "tiny",
+    "init_seed": 0,
+    "model_overrides": {"compute_dtype": "float32"},
+    "engine": {"max_batch": 2, "n_blocks": 24, "block_size": 8,
+               "temperature": 0.0, "steps_per_sched": 4,
+               "pipeline_depth": 2},
+    "admission": {"max_queue_depth": 8},
+}
+replicas = []
+for i in range(2):
+    s = dict(spec)
+    s["attach"] = os.environ[f"MH_ADDR{i}"]
+    s["token"] = "mh-smoke-token"
+    replicas.append(RemoteReplica(i, s, bus=bus, fault_injector=faults,
+                                  lease_s=0.8))
+# eject_backoff must outlast the drill: a relaunch attempt would tear
+# down the blackholed gate and discard the stale frames heal must count.
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=60.0).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+load = LoadSpec(n_requests=12, mode="closed", concurrency=4, seed=9,
+                vocab_size=replicas[0].engine.cfg.vocab_size,
+                max_new_min=6, max_new_max=10)
+report = run_http(base, load)
+
+lost = load.n_requests - len(report.outcomes)
+assert lost == 0, f"{lost} requests lost"
+statuses = {}
+for o in report.outcomes:
+    statuses[o.status] = statuses.get(o.status, 0) + 1
+assert statuses == {"done": 12}, statuses
+summary = report.summary()
+assert summary["redrives_total"] >= 1, summary
+assert router.counters["ejects"] >= 1, router.counters
+assert replicas[0].mode == "attach" and replicas[0].proc is None
+assert replicas[0]._c_lease.value >= 1, "lease never expired"
+assert replicas[0].fence >= 1, "fence generation never bumped"
+
+# Heal the partition: everything the blackholed worker streamed while
+# fenced must now arrive, be counted as stale, and be dropped.
+replicas[0].heal()
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if replicas[0]._c_fenced.value >= 1:
+        break
+    time.sleep(0.05)
+assert replicas[0]._c_fenced.value >= 1, "no stale frames were fenced"
+
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_lease_expiries_total" in text, text[:400]
+assert "pllm_serving_fenced_frames_total" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"multi-host smoke ok: {statuses}, "
+      f"redrives={router.counters['redrives']}, "
+      f"lease_expiries={int(replicas[0]._c_lease.value)}, "
+      f"fenced={int(replicas[0]._c_fenced.value)}")
+EOF
+
+# Detach is not death: the pre-spawned workers must still be alive after
+# the router shut down (attach mode never owns the worker lifecycle).
+for pid in "$MH_W0" "$MH_W1"; do
+    kill -0 "$pid" 2>/dev/null || {
+        echo "pre-spawned worker $pid died across router detach"; exit 1; }
+done
+kill "$MH_W0" "$MH_W1" 2>/dev/null || true
+wait "$MH_W0" "$MH_W1" 2>/dev/null || true
+
+# The offline auditor must join the injected partition to its detection
+# (lease expiry, not fence drop — the fence notice lands at heal) and to
+# the redrives it caused, with zero lost requests.
+python scripts/obs_report.py --fleet --strict \
+    "$OBS_TMP/mh_events.jsonl" > "$OBS_TMP/mh_report.out"
+grep -q "lost=0" "$OBS_TMP/mh_report.out" || {
+    echo "obs_report --fleet (multi-host) did not report lost=0"; exit 1; }
+grep -q "detected by lease_expiry" "$OBS_TMP/mh_report.out" || {
+    echo "obs_report --fleet missing the partition detection join"; exit 1; }
 
 # Integrity gate: a 2-replica fleet with golden probes on and a
 # corrupt_kv_page injected on replica 0 mid-burst — the flipped page is

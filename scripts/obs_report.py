@@ -43,9 +43,14 @@ zero-lost invariant a replica-crash drill is checking), the
 replica-tagged ``req_*`` streams become per-replica waterfalls,
 ``redrive`` events are folded into failover cost (requests redriven,
 committed tokens carried over, e2e penalty vs. undisturbed), and
-``replica_state`` transitions into per-incident recovery times. Under
-``--strict`` a lost request or dangling redrive is fatal, which is the
-CI fleet gate.
+``replica_state`` transitions into per-incident recovery times. Injected
+network partitions (``partition_injected``) are joined to whichever
+mechanism detected them — ``lease_expired`` (heartbeats stopped) or
+``fenced_frames_dropped`` (stale-generation frames arrived after heal) —
+plus the redrives they caused; ``journal_replay`` events summarize a
+router restart recovering from its fleet journal. Under ``--strict`` a
+lost request, dangling redrive, or UNDETECTED partition is fatal, which
+is the CI fleet gate.
 
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
@@ -910,6 +915,113 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "restored": sum(1 for e in up_rolled if e.get("restored")),
         }
 
+    # Partitions: join each injected blackhole to the mechanism that
+    # detected it — lease expiry (the router stopped hearing heartbeats)
+    # or a fence drop (stale-generation frames arrived after heal) —
+    # and to the redrives it caused. An injected partition that NOTHING
+    # detected means a worker can stream stale tokens unnoticed, which
+    # is the one unacceptable end state, so it is strict.
+    p_inject = [e for e in events if e.get("event") == "partition_injected"]
+    p_heal = [e for e in events if e.get("event") == "partition_healed"]
+    leases = [e for e in events if e.get("event") == "lease_expired"]
+    fenced = [e for e in events if e.get("event") == "fenced_frames_dropped"]
+    f_bumps = [e for e in events if e.get("event") == "fence_bump"]
+    j_replays = [e for e in events if e.get("event") == "journal_replay"]
+    partitions = None
+    if p_inject or p_heal or leases or fenced:
+        part_incidents: List[Dict[str, Any]] = []
+        for e in p_inject:
+            rep = int(e.get("replica", -1))
+            t0 = float(e.get("t_mono", 0.0))
+            # Detection events carry their own bus timestamps; give the
+            # join a small backwards grace window for clock skew between
+            # the injector thread and the health/reader threads.
+            lease_hit = next(
+                (
+                    le for le in sorted(
+                        leases, key=lambda x: float(x.get("t_mono", 0.0))
+                    )
+                    if int(le.get("replica", -2)) == rep
+                    and float(le.get("t_mono", 0.0)) >= t0 - 1.0
+                ),
+                None,
+            )
+            fence_hit = next(
+                (
+                    fe for fe in sorted(
+                        fenced, key=lambda x: float(x.get("t_mono", 0.0))
+                    )
+                    if int(fe.get("replica", -2)) == rep
+                    and float(fe.get("t_mono", 0.0)) >= t0 - 1.0
+                ),
+                None,
+            )
+            hits = [
+                ("lease_expiry", lease_hit),
+                ("fence_drop", fence_hit),
+            ]
+            hits = [
+                (k, h) for k, h in hits if h is not None
+            ]
+            hits.sort(key=lambda kh: float(kh[1].get("t_mono", 0.0)))
+            detected_by = hits[0][0] if hits else None
+            detect_s = (
+                max(0.0, float(hits[0][1].get("t_mono", 0.0)) - t0)
+                if hits else None
+            )
+            heal = next(
+                (
+                    h for h in sorted(
+                        p_heal, key=lambda x: float(x.get("t_mono", 0.0))
+                    )
+                    if int(h.get("replica", -2)) == rep
+                    and float(h.get("t_mono", 0.0)) >= t0
+                ),
+                None,
+            )
+            t_end = (
+                float(heal.get("t_mono", 0.0))
+                if heal is not None else float("inf")
+            )
+            caused = [
+                r for r in redrives
+                if r.get("from_replica") == rep
+                and t0 - 1.0 <= float(r.get("t_mono", 0.0)) <= t_end + 1.0
+            ]
+            if detected_by is None:
+                problems.append(
+                    f"UNDETECTED partition on replica {rep}: neither a "
+                    f"lease expiry nor a fenced-frame drop followed the "
+                    f"injection (stale tokens could stream unnoticed)"
+                )
+            part_incidents.append({
+                "replica": rep,
+                "detected_by": detected_by,
+                "detect_s": detect_s,
+                "healed": heal is not None,
+                "redrives_caused": len(caused),
+                "tokens_carried_over": sum(
+                    int(r.get("n_committed", 0)) for r in caused
+                ),
+            })
+        partitions = {
+            "injected": len(p_inject),
+            "healed": len(p_heal),
+            "lease_expiries": len(leases),
+            "fence_drop_notices": len(fenced),
+            "fence_bumps": len(f_bumps),
+            "incidents": part_incidents,
+        }
+
+    journal = None
+    if j_replays:
+        journal = {
+            "replays": len(j_replays),
+            "tokens_resumed_from": sum(
+                int(e.get("n_committed", 0)) for e in j_replays
+            ),
+        }
+
     return {
         "n_submitted": len(submits),
         "n_terminal": len(terms),
@@ -921,6 +1033,8 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "brownout_transitions": len(brownouts),
         "workers": workers,
         "upgrades": upgrades,
+        "partitions": partitions,
+        "journal": journal,
         "problems": problems,
     }
 
@@ -995,6 +1109,33 @@ def print_fleet_report(report: Dict[str, Any]) -> None:
             f"started={u['started']} vetted={u['vetted']} "
             f"refused={u['refused']} rolled_back={u['rolled_back']} "
             f"restored={u['restored']}"
+        )
+    pt = report.get("partitions")
+    if pt:
+        print("== partitions ==")
+        print(
+            f"injected={pt['injected']} healed={pt['healed']} "
+            f"lease_expiries={pt['lease_expiries']} "
+            f"fence_drop_notices={pt['fence_drop_notices']} "
+            f"fence_bumps={pt['fence_bumps']}"
+        )
+        for inc in pt["incidents"]:
+            det = (
+                f"{inc['detected_by']} in {inc['detect_s']:.3f}s"
+                if inc["detected_by"] is not None else "UNDETECTED"
+            )
+            print(
+                f"  partition: replica {inc['replica']} -> detected by "
+                f"{det}, {inc['redrives_caused']} redrives "
+                f"({inc['tokens_carried_over']} tokens carried), "
+                f"healed={inc['healed']}"
+            )
+    j = report.get("journal")
+    if j:
+        print("== journal recovery ==")
+        print(
+            f"replays={j['replays']} "
+            f"tokens_resumed_from={j['tokens_resumed_from']}"
         )
     for p in report["problems"]:
         print(f"!! {p}")
@@ -1324,8 +1465,10 @@ def main() -> int:
         "--fleet", action="store_true",
         help="fleet attribution from fleet_req_*/redrive/replica_state "
         "events: request conservation (every submit reaches a terminal), "
-        "per-replica waterfalls, redrive cost, replica recovery time; "
-        "--strict makes a lost request or a dangling redrive fatal",
+        "per-replica waterfalls, redrive cost, replica recovery time, "
+        "partition detection joins (lease expiry vs fence drop), journal "
+        "replays; --strict makes a lost request, a dangling redrive, or "
+        "an undetected partition fatal",
     )
     parser.add_argument(
         "--integrity", action="store_true",

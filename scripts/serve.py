@@ -142,6 +142,35 @@ def main() -> None:
                         "behind a socket (real kill -9 fault domain, "
                         "rolling weight upgrades). Router/gateway "
                         "behavior is identical (default: config)")
+    parser.add_argument("--attach", default=None,
+                        help="(--http, replica_mode=process) attach to "
+                        "pre-spawned workers (worker.py --listen) instead "
+                        "of spawning: comma-separated host:port list, one "
+                        "address per replica. Attached workers are "
+                        "detached, never killed, at teardown "
+                        "(default: config frontend.worker_attach)")
+    parser.add_argument("--attach_token", default=None,
+                        help="(--http) shared secret for the attach "
+                        "handshake; must match the worker's --token "
+                        "(default: config)")
+    parser.add_argument("--lease_s", type=float, default=None,
+                        help="(--http) heartbeat lease: a worker that "
+                        "hears nothing from the router for this long "
+                        "stops admitting and parks; the router redrives "
+                        "its in-flight work. 0 = disabled "
+                        "(default: config)")
+    parser.add_argument("--journal_path", default=None,
+                        help="(--http) write-ahead fleet journal JSONL: "
+                        "membership, fence generations, committed "
+                        "frontiers — enough to restart the router "
+                        "without losing or duplicating a request "
+                        "(default: config)")
+    parser.add_argument("--recover", action="store_true",
+                        help="(--http) recover router state from "
+                        "--journal_path before taking traffic: re-attach "
+                        "survivors, fence the old generation, redrive "
+                        "journaled in-flight requests from their last "
+                        "committed frontier")
     parser.add_argument("--serving_faults", default=None,
                         help="(--http) serving fault plan, e.g. "
                         "'replica_crash@req3:r0,slow_window@req5' — a "
@@ -334,6 +363,21 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     n_replicas = pick(args.replicas, fc.replicas)
     replica_mode = pick(args.replica_mode, fc.replica_mode)
     fault_spec = pick(args.serving_faults, fc.serving_faults)
+    attach = pick(args.attach, fc.worker_attach)
+    attach_token = pick(args.attach_token, fc.attach_token)
+    lease_s = pick(args.lease_s, fc.lease_s)
+    journal_path = pick(args.journal_path, fc.journal_path)
+    attach_addrs = [a.strip() for a in attach.split(",")] if attach else []
+    if attach_addrs:
+        if replica_mode != "process":
+            raise SystemExit("--attach needs --replica_mode process")
+        if len(attach_addrs) != n_replicas:
+            raise SystemExit(
+                f"--attach lists {len(attach_addrs)} addresses for "
+                f"{n_replicas} replicas"
+            )
+    if args.recover and not journal_path:
+        raise SystemExit("--recover needs --journal_path")
     max_queue_depth = pick(args.max_queue_depth, fc.max_queue_depth)
     max_outstanding = pick(
         args.max_outstanding_tokens, fc.max_outstanding_tokens
@@ -375,6 +419,8 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             probe_interval_s=pick(args.probe_interval_s, fc.probe_interval_s),
             probe_count=pick(args.probe_count, fc.probe_count),
             probe_max_new=pick(args.probe_max_new, fc.probe_max_new),
+            journal_path=journal_path,
+            recover=args.recover,
         ).start()
 
     if replica_mode == "process":
@@ -434,12 +480,24 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             loop=loop_kwargs,
             serving_faults=engine_plan,
         )
+        def _rep_spec(i):
+            # Attach mode: each replica gets its own pre-spawned worker
+            # address (plus the shared token); spawn mode shares the spec.
+            if not attach_addrs:
+                return worker_spec
+            s = dict(worker_spec)
+            s["attach"] = attach_addrs[i]
+            if attach_token:
+                s["token"] = attach_token
+            return s
+
         replicas = [
             RemoteReplica(
-                i, worker_spec, bus=bus,
+                i, _rep_spec(i), bus=bus,
                 registry_labels={"quant_dtype": quantize},
                 fault_injector=proc_faults,
                 backoff_seed=args.seed,
+                lease_s=lease_s,
             )
             for i in range(n_replicas)
         ]

@@ -1,0 +1,568 @@
+"""Multi-host fleet: remote worker attach, lease-fenced partition
+tolerance, and the crash-recoverable router control plane.
+
+The correctness bar is test_process_fleet.py's, extended to faults a
+real network brings that PR 12's connection-loss model cannot express:
+
+- a silent PARTITION (no RST, no EOF — reads hang, writes buffer) must
+  be detected by the heartbeat lease, its requests redriven to a
+  survivor bit-identically, and the frames the blackholed worker
+  streamed into the void must arrive after heal stamped with a stale
+  fence generation — counted and DROPPED, never delivered twice;
+- a pre-spawned ``worker.py --listen`` worker must refuse attaches
+  with a bad token or the wrong engine fingerprint, survive a router
+  detach, and serve the next attach;
+- a router CRASH (no shutdown, no terminals — just gone) must be
+  recoverable from the write-ahead fleet journal: a new router
+  re-attaches the still-live workers, fences the old generation, and
+  finishes every journaled in-flight request exactly once with greedy
+  output bit-identical to an undisturbed run, at every pipeline depth,
+  prefix cache on or off.
+
+Workers build their own params from (preset, init_seed) — the same
+``init_params(cfg, key(0))`` this module's reference engine uses — so
+bit-identity assertions compare real decode output across processes.
+
+The wire/journal/config unit tests are tier-1 (no JAX, no subprocess);
+the attach/partition/restart drills spawn real worker processes and
+build engines, so they are marked ``slow`` and run in ``ci_smoke.sh``.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import FrontendConfig, get_preset
+from pretraining_llm_tpu.frontend.journal import FleetJournal
+from pretraining_llm_tpu.frontend.loadgen import FleetAction
+from pretraining_llm_tpu.frontend.remote_replica import (
+    RemoteReplica,
+    ReplicaUnavailable,
+)
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.frontend.wire import (
+    MAX_FRAME_BYTES,
+    ConnectionLost,
+    ProtocolError,
+    encode_frame,
+    recv_frame,
+    send_frame,
+)
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import render_merged
+from pretraining_llm_tpu.resilience.faults import (
+    ServingFaultInjector,
+    split_serving_plan,
+)
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "obs_report_for_multihost", os.path.join(_REPO, "scripts", "obs_report.py")
+)
+obs_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)])).tolist()
+        for i in range(n)
+    ]
+
+
+def _engine_kw(**kw):
+    out = dict(
+        max_batch=2, n_blocks=24, block_size=8, temperature=0.0,
+        steps_per_sched=4, pipeline_depth=2,
+    )
+    out.update(kw)
+    return out
+
+
+def _worker_spec(**engine_kw):
+    return {
+        "preset": "tiny",
+        "init_seed": 0,
+        "model_overrides": {"compute_dtype": "float32"},
+        "engine": _engine_kw(**engine_kw),
+        "admission": {"max_queue_depth": 8},
+    }
+
+
+def _undisturbed(params, prompts, n_new, **kw):
+    eng = ServingEngine(params, CFG, **_engine_kw(**kw))
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return {rids[rid]: toks for rid, toks in out.items()}
+
+
+def _spawn_listen_worker(token="", engine_kw=None):
+    """Spawn a pre-spawned multi-host worker (``--listen``) and return
+    (proc, "host:port") once it announces its bound port."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pretraining_llm_tpu.frontend.worker",
+        "--spec-json", json.dumps(_worker_spec(**(engine_kw or {}))),
+        "--listen", "127.0.0.1:0",
+    ]
+    if token:
+        cmd += ["--token", token]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=None, env=env
+    )
+    try:
+        line = proc.stdout.readline()
+        port = int(json.loads(line)["worker"]["port"])
+    except Exception:
+        proc.kill()
+        raise
+    return proc, f"127.0.0.1:{port}"
+
+
+def _attach_spec(address, token="", engine_kw=None, **extra):
+    spec = _worker_spec(**(engine_kw or {}))
+    spec["attach"] = address
+    if token:
+        spec["token"] = token
+    spec.update(extra)
+    return spec
+
+
+def _kill(procs):
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+# -- wire: partial writes, torn and interleaved frames (no JAX) -------------
+
+
+def test_wire_send_deadline_on_stuffed_peer():
+    """A peer that stops reading must not hang the sender forever: the
+    chunked send loop gives up at its per-frame deadline with the
+    redrivable ConnectionLost, reporting the partial write."""
+    a, b = socket.socketpair()
+    try:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        payload = {"blob": "x" * 262144}
+        with pytest.raises(ConnectionLost, match="send deadline"):
+            # The peer never reads: once both kernel buffers fill, the
+            # send loop can make no progress and must time out.
+            for _ in range(64):
+                send_frame(a, payload, deadline_s=0.5)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_torn_length_prefix_is_connection_lost():
+    a, b = socket.socketpair()
+    # Deliver 2 of the 4 length-prefix bytes, then die mid-prefix.
+    a.sendall(b"\x00\x00")
+    a.close()
+    with pytest.raises(ConnectionLost):
+        recv_frame(b)
+    b.close()
+
+
+def test_wire_torn_body_is_connection_lost():
+    a, b = socket.socketpair()
+    body = json.dumps({"op": "hello"}).encode()
+    # Full prefix, half the declared body, then EOF.
+    a.sendall(struct.pack(">I", len(body)) + body[: len(body) // 2])
+    a.close()
+    with pytest.raises(ConnectionLost):
+        recv_frame(b)
+    b.close()
+
+
+def test_wire_interleaved_half_frames_reassemble():
+    """Two frames delivered in slices that straddle the frame boundary
+    (a slow peer dribbling bytes) must reassemble exactly — framing
+    state never leaks across recv_frame calls."""
+    a, b = socket.socketpair()
+    try:
+        p1 = {"op": "submit", "rid": 1, "prompt": [1, 2, 3]}
+        p2 = {"op": "health", "id": 2}
+        blob = encode_frame(p1) + encode_frame(p2)
+        cuts = [3, len(encode_frame(p1)) - 2, len(encode_frame(p1)) + 5]
+        pieces = [
+            blob[i:j] for i, j in zip([0] + cuts, cuts + [len(blob)])
+        ]
+
+        def _dribble():
+            for piece in pieces:
+                a.sendall(piece)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=_dribble, daemon=True)
+        t.start()
+        assert recv_frame(b) == p1
+        assert recv_frame(b) == p2
+        t.join(timeout=5)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_oversized_length_prefix_fails_fast():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", MAX_FRAME_BYTES + 1))
+        with pytest.raises(ProtocolError):
+            recv_frame(b)
+        a.sendall(struct.pack(">I", 0xFFFFFFFF))
+        with pytest.raises(ProtocolError):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- fleet journal (no JAX, no socket) --------------------------------------
+
+
+def test_journal_roundtrip_and_closed_append(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    j = FleetJournal(path)
+    j.append({"rec": "member", "replica": 0, "mode": "attach"})
+    j.append({"rec": "submit", "frid": 0, "prompt": [1, 2], "max_new": 4})
+    j.close()
+    j.append({"rec": "terminal", "frid": 0, "status": "done"})  # dropped
+    records = FleetJournal.load(path)
+    assert [r["rec"] for r in records] == ["member", "submit"]
+    # Reopening appends — restart semantics, not truncation.
+    j2 = FleetJournal(path)
+    j2.append({"rec": "terminal", "frid": 0, "status": "done"})
+    j2.close()
+    assert len(FleetJournal.load(path)) == 3
+
+
+def test_journal_torn_final_line_tolerated(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    j = FleetJournal(path)
+    j.append({"rec": "submit", "frid": 0, "prompt": [5], "max_new": 2})
+    j.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"rec": "frontier", "frid": 0, "tok')  # crash mid-write
+    records = FleetJournal.load(path)
+    assert len(records) == 1 and records[0]["rec"] == "submit"
+    assert FleetJournal.load(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_journal_recovery_plan():
+    records = [
+        {"rec": "member", "replica": 0, "mode": "attach"},
+        {"rec": "fence", "replica": 0, "fence": 1},
+        {"rec": "fence", "replica": 0, "fence": 3},
+        {"rec": "fence", "replica": 1, "fence": 0},
+        {"rec": "submit", "frid": 0, "prompt": [1], "max_new": 4,
+         "priority": 0, "deadline_s": None},
+        {"rec": "submit", "frid": 1, "prompt": [2, 3], "max_new": 6,
+         "priority": 1, "deadline_s": 2.0},
+        {"rec": "submit", "frid": 2, "prompt": [4], "max_new": 4,
+         "priority": 0, "deadline_s": None},
+        {"rec": "frontier", "frid": 1, "tokens": [9, 8, 7], "redrives": 1},
+        {"rec": "terminal", "frid": 0, "status": "done"},
+    ]
+    plan = FleetJournal.recovery_plan(records)
+    assert plan["fences"] == {0: 3, 1: 0}
+    assert plan["next_frid"] == 3
+    assert sorted(plan["live"]) == [1, 2]
+    assert plan["live"][1]["tokens"] == [9, 8, 7]
+    assert plan["live"][1]["redrives"] == 1
+    assert plan["live"][1]["priority"] == 1
+    assert plan["live"][2]["tokens"] == []
+
+
+def test_router_recover_requires_journal_path():
+    with pytest.raises(ValueError, match="journal_path"):
+        Router([Replica(0, lambda: None)], recover=True)
+
+
+# -- fault grammar + actions + config ---------------------------------------
+
+
+def test_partition_faults_are_process_kinds():
+    engine, process = split_serving_plan(
+        "partition@req2:r0, wire_delay@req1:r1, replica_crash@req3:r0"
+    )
+    assert engine == "replica_crash@req3:r0"
+    assert process == "partition@req2:r0,wire_delay@req1:r1"
+
+
+def test_fleet_action_partition_heal_validation():
+    assert FleetAction(at_s=0.5, kind="partition", replica=0).kind == "partition"
+    assert FleetAction(at_s=1.0, kind="heal", replica=1).kind == "heal"
+    with pytest.raises(ValueError):
+        FleetAction(at_s=0.5, kind="partition", replica=0, update={"x": 1})
+
+
+def test_frontend_config_multihost_validation():
+    ok = FrontendConfig(
+        replicas=2, replica_mode="process",
+        worker_attach="10.0.0.1:7000,10.0.0.2:7000",
+        attach_token="s3cret", lease_s=2.0, journal_path="fleet.jsonl",
+    )
+    assert ok.lease_s == 2.0
+    with pytest.raises(ValueError, match="lease_s"):
+        FrontendConfig(lease_s=-1.0)
+    with pytest.raises(ValueError, match="replica_mode"):
+        FrontendConfig(replicas=1, worker_attach="h:1")
+    with pytest.raises(ValueError, match="addresses"):
+        FrontendConfig(
+            replicas=2, replica_mode="process", worker_attach="h:1"
+        )
+    with pytest.raises(ValueError, match="host:port"):
+        FrontendConfig(
+            replicas=1, replica_mode="process", worker_attach="nonsense"
+        )
+    with pytest.raises(ValueError, match="attach_token"):
+        FrontendConfig(attach_token="s3cret")
+
+
+# -- attach handshake: token, fingerprint, detach-survival ------------------
+
+
+@pytest.mark.slow
+def test_attach_handshake_token_fingerprint_and_detach(params):
+    """One pre-spawned ``--listen`` worker: a wrong token is refused, a
+    wrong expected fingerprint is refused, the right token serves decode
+    bit-identically, and a router detach leaves the worker alive and
+    ready for the NEXT attach."""
+    prompts = _prompts(2)
+    ref = _undisturbed(params, prompts, 4)
+    proc, addr = _spawn_listen_worker(token="s3cret")
+    try:
+        # Anyone can reach the TCP port; only the token holder attaches.
+        bad = RemoteReplica(0, _attach_spec(addr, token="wrong"))
+        with pytest.raises(Exception, match="unauthorized|token"):
+            bad.start()
+
+        # Wrong weights behind the address: the fingerprint check in the
+        # hello refuses the attach before any traffic is routed.
+        finger = RemoteReplica(
+            0, _attach_spec(addr, token="s3cret", expect_fingerprint="bogus")
+        )
+        with pytest.raises(ReplicaUnavailable, match="fingerprint"):
+            finger.start()
+
+        rep = RemoteReplica(0, _attach_spec(addr, token="s3cret"))
+        rep.start()
+        assert rep.mode == "attach"
+        assert rep.proc is None  # not our child — attached, not spawned
+        reqs = [rep.submit(p, 4) for p in prompts]
+        for i, r in enumerate(reqs):
+            status, tokens, _ = r.result(timeout=120)
+            assert status == "done"
+            assert tokens == ref[i]
+        rep.stop()
+        assert proc.poll() is None, "detach must NOT kill the worker"
+
+        # The parked worker serves the next attach (fresh router).
+        rep2 = RemoteReplica(0, _attach_spec(addr, token="s3cret"))
+        rep2.start()
+        w = rep2.submit(prompts[0], 4)
+        status, tokens, _ = w.result(timeout=120)
+        assert status == "done" and tokens == ref[0]
+        rep2.stop()
+        assert proc.poll() is None
+    finally:
+        _kill([proc])
+
+
+# -- partition drill: lease expiry, fence drop, bit-identity ----------------
+
+
+@pytest.mark.slow
+def test_partition_heal_fence_bit_identity(params, tmp_path):
+    """Blackhole an attached worker mid-decode. The lease detects it
+    (no RST ever arrives), its in-flight requests redrive to the
+    survivor bit-identically, and after heal the frames it streamed
+    into the void arrive stamped with the stale fence generation — every
+    one counted and dropped, zero duplicate tokens delivered."""
+    prompts = _prompts(4)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new)
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(jsonl_path=str(path))
+    procs, addrs = [], []
+    for _ in range(2):
+        proc, addr = _spawn_listen_worker()
+        procs.append(proc)
+        addrs.append(addr)
+    try:
+        faults = ServingFaultInjector("partition@req2:r0", bus=bus)
+        reps = [
+            RemoteReplica(
+                i, _attach_spec(addrs[i]), bus=bus,
+                fault_injector=faults, lease_s=0.8,
+            )
+            for i in range(2)
+        ]
+        # Backoff > test body: no relaunch tears down the partitioned
+        # gate, so the post-heal backlog survives to hit the fence.
+        router = Router(reps, bus=bus, eject_backoff_s=60.0)
+        with router:
+            reqs = [router.submit(p, n_new) for p in prompts]
+            results = [r.result(timeout=120) for r in reqs]
+            for i, (status, tokens, info) in enumerate(results):
+                assert status == "done", (i, status, info)
+                assert tokens == ref[i], f"request {i} diverged"
+            assert router.counters["redrives"] >= 1
+            assert router.counters["ejects"] >= 1
+            assert reps[0]._c_lease.value >= 1
+            assert reps[0].fence >= 1  # ejected -> fenced
+            # Heal: the blackholed worker's buffered frames flood in,
+            # all stamped with the pre-bump generation.
+            reps[0].heal()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if reps[0]._c_fenced.value >= 1:
+                    break
+                time.sleep(0.05)
+            assert reps[0]._c_fenced.value >= 1, (
+                "healed backlog never hit the fence filter"
+            )
+            # Zero duplicates: the fence dropped the stale stream, so no
+            # request's committed tokens overran its budget.
+            for _, tokens, _info in results:
+                assert len(tokens) == n_new
+            text = render_merged([rep.registry for rep in reps])
+            assert lint_exposition(text) == []
+            assert "pllm_serving_lease_expiries_total" in text
+            assert "pllm_serving_fenced_frames_total" in text
+    finally:
+        _kill(procs)
+    bus.close()
+
+    events = [json.loads(ln) for ln in path.read_text().splitlines()]
+    report = obs_report.build_fleet_report(events)
+    assert report["lost_requests"] == 0
+    pt = report["partitions"]
+    assert pt is not None and pt["injected"] == 1
+    assert pt["healed"] == 1
+    inc = pt["incidents"][0]
+    assert inc["replica"] == 0
+    assert inc["detected_by"] == "lease_expiry"
+    assert inc["redrives_caused"] >= 1
+    assert not any("UNDETECTED" in p for p in report["problems"])
+
+
+# -- router crash + journal recovery ----------------------------------------
+
+_RESTART_GRID = [
+    (1, False), (1, True), (2, False), (2, True), (3, False), (3, True),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth,cache", _RESTART_GRID)
+def test_router_restart_recovers_journal(params, tmp_path, depth, cache):
+    """Kill the router mid-burst (no shutdown, no terminals) with
+    attached workers alive. A new router recovering from the journal
+    re-attaches the same workers, fences the old generation, and
+    finishes every in-flight request exactly once — greedy outputs
+    bit-identical to an undisturbed run, at every pipeline depth,
+    prefix cache on or off."""
+    prompts = _prompts(4)
+    n_new = 6
+    kw = dict(pipeline_depth=depth, prefix_cache=cache)
+    ref = _undisturbed(params, prompts, n_new, **kw)
+    journal = str(tmp_path / "fleet.jsonl")
+    token = "journal-tok"
+    procs, addrs = [], []
+    for _ in range(2):
+        proc, addr = _spawn_listen_worker(token=token, engine_kw=kw)
+        procs.append(proc)
+        addrs.append(addr)
+    try:
+        reps1 = [
+            RemoteReplica(
+                i, _attach_spec(addrs[i], token=token, engine_kw=kw),
+                lease_s=1.0,
+            )
+            for i in range(2)
+        ]
+        router1 = Router(reps1, eject_backoff_s=60.0, journal_path=journal)
+        router1.start()
+        reqs = [router1.submit(p, n_new) for p in prompts]
+        time.sleep(0.15)  # mid-burst: some done, some in flight
+        router1.abort()  # the crash: no RPCs, no terminals, no events
+        fence_before = {rep.index: rep.fence for rep in reps1}
+
+        finished = {
+            i: list(r.tokens)
+            for i, r in enumerate(reqs) if r.status == "done"
+        }
+        for i, tokens in finished.items():
+            assert tokens == ref[i]
+        pending = set(range(len(prompts))) - set(finished)
+        assert all(p.poll() is None for p in procs), (
+            "workers must survive the router crash"
+        )
+
+        reps2 = [
+            RemoteReplica(
+                i, _attach_spec(addrs[i], token=token, engine_kw=kw),
+                lease_s=1.0,
+            )
+            for i in range(2)
+        ]
+        router2 = Router(
+            reps2, eject_backoff_s=60.0,
+            journal_path=journal, recover=True,
+        )
+        try:
+            router2.start()
+            # The old generation is fenced everywhere before traffic.
+            for rep in reps2:
+                assert rep.fence > fence_before[rep.index]
+            # Exactly once: precisely the requests without journaled
+            # terminals are replayed — finished ones never re-run.
+            assert set(router2.recovered) == pending
+            assert router2.counters["journal_replays"] == len(pending)
+            for frid, rreq in router2.recovered.items():
+                status, tokens, info = rreq.result(timeout=120)
+                assert status == "done", (frid, status, info)
+                assert tokens == ref[frid], (
+                    f"replayed request {frid} diverged after recovery"
+                )
+        finally:
+            router2.stop()
+        assert all(p.poll() is None for p in procs)
+    finally:
+        _kill(procs)
