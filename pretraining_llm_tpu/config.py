@@ -916,6 +916,11 @@ class FrontendConfig:
     # restarted router to re-attach survivors, fence the old generation,
     # and redrive in-flight requests bit-identically ("" = no journal).
     journal_path: str = ""
+    # Journal compaction threshold in MB: once the JSONL grows past this,
+    # the journal rewrites itself down to its recovery_plan fold (fences,
+    # live request frontiers, next_frid) via an atomic tmp+rename. 0
+    # disables rotation (the journal grows without bound).
+    journal_rotate_mb: float = 64.0
     # Serving-path fault plan, e.g. "replica_crash@req3:r0,slow_window@req5"
     # ("" = none). See resilience.faults.parse_serving_faults.
     serving_faults: str = ""
@@ -1013,6 +1018,11 @@ class FrontendConfig:
             )
         if self.lease_s < 0:
             raise ValueError(f"lease_s must be >= 0, got {self.lease_s}")
+        if self.journal_rotate_mb < 0:
+            raise ValueError(
+                f"journal_rotate_mb must be >= 0 (0 disables rotation), "
+                f"got {self.journal_rotate_mb}"
+            )
         if self.worker_attach:
             if self.replica_mode != "process":
                 raise ValueError(

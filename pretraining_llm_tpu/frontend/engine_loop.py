@@ -50,6 +50,17 @@ TERMINAL_STATUSES = ("done", "cancelled", "expired", "error")
 _TRACE_UNSET = object()
 
 
+def _finish_trace(trace: Any, status: str, **meta: Any) -> None:
+    """Finish a request trace UNLESS its owner deferred the root: the
+    fleet router marks lineage-tree roots ``finish_deferred`` because an
+    attempt-level terminal here (e.g. "error" on a replica crash) is not
+    the request's fate — the router redrives and finishes the root once
+    the lineage settles."""
+    if trace is None or getattr(trace, "finish_deferred", False):
+        return
+    trace.finish(status, **meta)
+
+
 @dataclasses.dataclass
 class FrontendRequest:
     """One in-flight request as the frontend sees it. ``out_q`` carries
@@ -485,8 +496,7 @@ class EngineLoop:
             # the queue-depth slot leaks until restart.
             if ticket is not None:
                 self.admission.release(ticket)
-            if trace is not None:
-                trace.finish("error", reason="submit failed")
+            _finish_trace(trace, "error", reason="submit failed")
             raise
         self._wake.set()
         return req
@@ -517,7 +527,7 @@ class EngineLoop:
                 "req.admission", time.perf_counter(),
                 outcome="rejected", reason=reason,
             )
-            trace.finish("rejected", reason=reason)
+            _finish_trace(trace, "rejected", reason=reason)
 
     def cancel(self, req: FrontendRequest) -> None:
         """Request cancellation (client disconnect / explicit abort). The
@@ -882,7 +892,7 @@ class EngineLoop:
                     req.trace.marks.get("submit", req.trace.t0),
                     outcome=status,
                 )
-            req.trace.finish(status, n_tokens=len(req.tokens))
+            _finish_trace(req.trace, status, n_tokens=len(req.tokens))
         if self.bus is not None:
             self.bus.emit(f"req_{status}", **info)
         req.out_q.put(("end", status, info))

@@ -11,7 +11,11 @@ device work. Endpoints:
                       terminal ``data: {"done": ...}`` and
                       ``data: [DONE]``); an inbound W3C ``traceparent``
                       header joins the caller's trace (when the loop has
-                      a tracer), and terminal bodies carry ``trace_id``;
+                      a tracer), and terminal bodies carry ``trace_id``
+                      plus — behind a fleet router — ``replica`` (which
+                      one served the final attempt) and ``redrives``, so
+                      a client can correlate its response with the
+                      request's lineage tree without parsing the trace;
   GET  /healthz       liveness + queue gauges + engine-loop staleness
                       (seconds since the last scheduler turn; 503 past
                       ``healthz_stale_after_s`` — a wedged loop must not

@@ -79,11 +79,13 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import spans as _spans
+from ..observability.clocksync import ClockSync
 from ..observability.metrics import MetricsRegistry
 from .admission import RejectedBusy
 from .engine_loop import _TRACE_UNSET, FrontendRequest
 from .replica import REPLICA_STATES, ReplicaUnavailable
-from .wire import ConnectionLost, recv_frame, send_frame
+from .wire import PROTO_VERSION, ConnectionLost, recv_frame, send_frame
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -350,6 +352,7 @@ class _RemoteLoop:
         *,
         deadline_s: Optional[float] = None,
         trace: Any = _TRACE_UNSET,
+        traceparent: Optional[str] = None,
         priority: int = 0,
     ) -> FrontendRequest:
         if not self.running:
@@ -361,6 +364,7 @@ class _RemoteLoop:
             priority=priority,
             lane="loop",
             trace=trace,
+            traceparent=traceparent,
         )
 
     def cancel(self, req: FrontendRequest) -> None:
@@ -425,6 +429,7 @@ class RemoteReplica:
         spawn_timeout_s: float = 600.0,
         health_interval_s: float = 0.05,
         lease_s: float = 0.0,
+        recorder: Any = None,
         python: str = sys.executable,
     ) -> None:
         self.index = int(index)
@@ -469,6 +474,22 @@ class RemoteReplica:
             "fenced_frames_total",
             "stale-generation frames dropped after a fence bump",
         )
+        self._c_spans = self.registry.counter(
+            "worker_spans_total",
+            "spans imported from the worker's span-export frames",
+        )
+        self._c_span_drops = self.registry.counter(
+            "worker_span_drops_total",
+            "spans the worker dropped before export (buffer saturated)",
+        )
+        self._g_clock_offset = self.registry.gauge(
+            "clock_offset_seconds",
+            "estimated worker->router perf_counter offset (min-RTT)",
+        )
+        self._g_clock_err = self.registry.gauge(
+            "clock_error_bound_seconds",
+            "half-RTT error bound on the current clock offset estimate",
+        )
 
         self.state = "ejected"
         self.generation = 0
@@ -504,6 +525,21 @@ class RemoteReplica:
         self._lease_fired_gen = 0
         self._fence_note_gen = 0
         self._parted_gate: Optional[_PartitionGate] = None
+
+        # Cross-process tracing: spans the worker exports land in this
+        # recorder (shared with the router's tracer by default, so one
+        # Chrome trace holds both timelines) after the clock estimator
+        # maps their worker-epoch perf_counter timestamps into ours.
+        # Each process has its own perf_counter zero, so the mapping is
+        # re-estimated from hello + every health heartbeat (Cristian
+        # min-RTT) and reset whenever the connection generation changes
+        # (a re-attached worker may be a different process entirely).
+        self.recorder = (
+            recorder if recorder is not None else _spans.get_recorder()
+        )
+        self.clock_sync = ClockSync()
+        self._clock_gen = 0
+        self._peer_proto = 1  # until a hello reply advertises more
 
         self.engine: Optional[_RemoteEngine] = None
         # None until first launch so Router.start()'s `rep.loop is None`
@@ -645,6 +681,7 @@ class RemoteReplica:
         hello_payload: Dict[str, Any] = {
             "fence": self.fence,
             "lease_s": self.lease_s,
+            "proto": PROTO_VERSION,
         }
         token = str(self.spec.get("token") or "")
         if token:
@@ -670,6 +707,7 @@ class RemoteReplica:
                 f"replica {self.index} attach refused: worker serves "
                 f"fingerprint {got!r}, expected {expect!r}"
             )
+        self._peer_proto = int(hello.get("proto", 1))
         self.engine = _RemoteEngine(self, hello)
         if self.loop is None:
             self.loop = _RemoteLoop(self)
@@ -848,6 +886,9 @@ class RemoteReplica:
                     ("end", attempt.status, dict(attempt.info))
                 )
             return
+        if frame.get("op") == "spans":
+            self._ingest_spans(frame)
+            return
         if frame.get("op") == "event" and self._bus is not None:
             try:
                 self._bus.emit(
@@ -858,12 +899,75 @@ class RemoteReplica:
             except Exception:
                 pass
 
+    def _observe_clock(
+        self, gen: int, t_send: float, t_recv: float, t_remote: float
+    ) -> None:
+        """Feed one RPC round trip into the offset estimator. Samples
+        are scoped to a connection generation: a re-attach may put a
+        DIFFERENT process (different perf_counter epoch) behind the same
+        address, so stale-generation samples are discarded and a new
+        generation resets the estimator before its first sample."""
+        with self._conn_lock:
+            cur = self._conn_gen
+        if gen != cur:
+            return
+        if self._clock_gen != gen:
+            self.clock_sync.reset()
+            self._clock_gen = gen
+        self.clock_sync.observe(t_send, t_recv, t_remote)
+        offset = self.clock_sync.offset_s
+        if offset is not None:
+            self._g_clock_offset.set(offset)
+            self._g_clock_err.set(self.clock_sync.error_bound_s or 0.0)
+
+    def _ingest_spans(self, frame: Dict[str, Any]) -> None:
+        """Import one batched span-export frame: map each worker-epoch
+        timestamp into the router timeline via the current offset
+        estimate (recording the error bound alongside), tag the span as
+        remote, and re-record it into the shared recorder so the merged
+        Chrome trace shows worker decode windows nested inside the
+        router's request spans. Spans arriving with no usable offset
+        estimate are kept but flagged ``unaligned`` — obs_report
+        --fleet-trace --strict fails on them rather than silently
+        plotting them in the wrong decade."""
+        dropped = int(frame.get("dropped", 0) or 0)
+        if dropped > 0:
+            self._c_span_drops.inc(dropped)
+        offset = self.clock_sync.offset_s
+        err = self.clock_sync.error_bound_s
+        n = 0
+        for ent in frame.get("spans") or []:
+            try:
+                name = str(ent["name"])
+                t0 = float(ent["t0"])
+                dur = max(0.0, float(ent.get("dur", 0.0)))
+            except (KeyError, TypeError, ValueError):
+                continue
+            meta = dict(ent.get("meta") or {})
+            track = meta.pop("_track", None)
+            meta["remote"] = True
+            meta["worker"] = self.index
+            if offset is not None:
+                t0 = t0 + offset
+                meta["clock_err_s"] = err
+            else:
+                meta["unaligned"] = True
+            self.recorder.record(name, t0, dur, meta=meta, track=track)
+            n += 1
+        if n:
+            self._c_spans.inc(n)
+
     @staticmethod
     def _finish_trace(attempt: FrontendRequest) -> None:
         trace = attempt.trace
         if trace is None:
             return
         try:
+            # Deferred roots (fleet lineage trees) are finished by the
+            # router after redrives settle — an attempt-level end here
+            # must not close them.
+            if getattr(trace, "finish_deferred", False):
+                return
             if not getattr(trace, "finished", True):
                 trace.finish(attempt.status)
         except Exception:
@@ -943,6 +1047,11 @@ class RemoteReplica:
                 self._pending[rid] = q
             frame = {"op": op, "id": rid, **(payload or {})}
             t0 = time.monotonic()
+            # perf_counter bracket for the clock estimator: the worker
+            # stamps ITS perf_counter into v2 hello/health replies, and
+            # offset = midpoint(t_send, t_recv) - t_remote maps its
+            # epoch into ours with error <= rtt/2.
+            t_send = time.perf_counter()
             try:
                 with self._wlock:
                     send_frame(sock, frame)
@@ -973,10 +1082,19 @@ class RemoteReplica:
             finally:
                 with self._pending_lock:
                     self._pending.pop(rid, None)
+            t_recv = time.perf_counter()
             self._h_rpc.observe(time.monotonic() - t0)
             self._last_ok = time.monotonic()
             if "ok" in reply:
-                return reply["ok"]
+                ok = reply["ok"]
+                if isinstance(ok, dict) and "clock" in ok:
+                    try:
+                        self._observe_clock(
+                            gen, t_send, t_recv, float(ok["clock"])
+                        )
+                    except (TypeError, ValueError):
+                        pass
+                return ok
             kind = reply.get("error", "runtime")
             message = str(reply.get("message", kind))
             if kind == "conn_lost":
@@ -1014,6 +1132,7 @@ class RemoteReplica:
         *,
         deadline_s: Optional[float] = None,
         trace: Any = _TRACE_UNSET,
+        traceparent: Optional[str] = None,
         priority: int = 0,
     ) -> FrontendRequest:
         with self._lock:
@@ -1035,6 +1154,7 @@ class RemoteReplica:
             priority=priority,
             lane="replica",
             trace=trace,
+            traceparent=traceparent,
         )
         with self._lock:
             self.submits += 1
@@ -1053,6 +1173,7 @@ class RemoteReplica:
         priority: int,
         lane: str,
         trace: Any = _TRACE_UNSET,
+        traceparent: Optional[str] = None,
     ) -> FrontendRequest:
         prompt_ids = [int(t) for t in prompt]
         now = time.monotonic()
@@ -1073,17 +1194,24 @@ class RemoteReplica:
         # token before the submit reply is even processed here.
         with self._attempts_lock:
             self._attempts[wrid] = attempt
+        payload = {
+            "rid": wrid,
+            "prompt": prompt_ids,
+            "max_new": int(max_new_tokens),
+            "deadline_s": deadline_s,
+            "priority": int(priority),
+            "lane": lane,
+        }
+        # Context propagation (v2 peers only — a v1 worker would still
+        # ignore the extra key, but being explicit keeps the contract
+        # legible): the worker joins this trace, parenting its local
+        # span tree under the router's placement-attempt span.
+        if traceparent is not None and self._peer_proto >= 2:
+            payload["traceparent"] = str(traceparent)
         try:
             self._rpc(
                 "submit",
-                {
-                    "rid": wrid,
-                    "prompt": prompt_ids,
-                    "max_new": int(max_new_tokens),
-                    "deadline_s": deadline_s,
-                    "priority": int(priority),
-                    "lane": lane,
-                },
+                payload,
                 retries=0,  # NEVER retried: ambiguous submits must fail
             )
         except Exception:

@@ -275,10 +275,17 @@ class Replica:
         *,
         deadline_s: Optional[float] = None,
         trace: Any = _TRACE_UNSET,
+        traceparent: Optional[str] = None,
         priority: int = 0,
     ) -> FrontendRequest:
         """Submit through the replica: availability gate, injected
         reject_storm gate, then the loop (validation + replica admission).
+
+        ``traceparent`` exists for signature parity with RemoteReplica
+        (the router hands every attempt both the trace object and its
+        wire form) and is ignored here: an in-process replica records
+        straight into the shared recorder through ``trace`` — there is
+        no process boundary to carry a header across.
         The fault clock counts ACCEPTED submissions and arms only after
         the loop took the request, so an armed crash always fires with
         its triggering request in flight — the redrive path, not just

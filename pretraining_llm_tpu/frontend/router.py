@@ -37,11 +37,20 @@ deadline longer than ``brownout_max_deadline_s`` (longest-deadline work
 has the most slack to retry later) — with 429 + Retry-After instead of
 failing everything.
 
-Tracing caveat: a request's RequestTrace follows its FIRST attempt (the
-replica loop records queue/window spans into it and finishes it at that
-attempt's terminal). Redriven attempts run untraced; the fleet event
-stream (``fleet_req_submit``/``redrive``/``fleet_req_terminal`` keyed by
-``frid``) is the cross-attempt audit log.
+Lineage tracing — every client request is ONE trace tree across all its
+placement attempts. The router owns the root span (``req.request``,
+``finish_deferred`` keeps replica loops from closing it early) and mints
+a child ``req.attempt`` span per placement, tagged (replica, fence
+generation, redrive index, outcome). In-process attempts record their
+engine spans straight into the shared recorder; remote attempts get a
+``traceparent`` pointing at the attempt span, so the worker's local span
+tree — shipped back in batched span-export frames and clock-aligned by
+RemoteReplica — nests under it. Redrives and journal replays link into
+the SAME tree: the journal's submit records carry ``trace_id``, so a
+recovered router continues the original trace instead of minting an
+orphan. The fleet event stream (``fleet_req_submit``/``redrive``/
+``fleet_req_terminal`` keyed by ``frid``) remains the flat audit log the
+trace tree is cross-checked against (obs_report --fleet-trace).
 """
 
 from __future__ import annotations
@@ -72,6 +81,11 @@ from pretraining_llm_tpu.frontend.replica import (
 )
 from pretraining_llm_tpu.observability.capacity import DecisionLog
 from pretraining_llm_tpu.observability.metrics import render_merged
+from pretraining_llm_tpu.observability.tracing import (
+    RequestTrace,
+    SpanContext,
+    format_traceparent,
+)
 
 
 def prefix_digest(prompt: Any, n_tokens: int) -> bytes:
@@ -125,6 +139,10 @@ class RouterRequest:
         self.redrives = 0
         self.replica: Optional[int] = None
         self._attempt: Optional[FrontendRequest] = None
+        # Open placement-attempt span: (span_id, t0, replica, fence).
+        # Spans are recorded at completion, so the router carries the
+        # pre-minted id here until the attempt ends (terminal/redrive).
+        self.attempt_span: Optional[Tuple[str, float, int, int]] = None
         self._lock = threading.Lock()
 
     def events(self, timeout: Optional[float] = None) -> Iterator[Tuple]:
@@ -185,12 +203,18 @@ class Router:
         probe_timeout_s: float = 30.0,
         probe_set: Optional[List[Any]] = None,
         journal_path: str = "",
+        journal_rotate_bytes: int = 0,
         recover: bool = False,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
         if recover and not journal_path:
             raise ValueError("recover=True needs a journal_path")
+        if journal_rotate_bytes < 0:
+            raise ValueError(
+                f"journal_rotate_bytes must be >= 0, got "
+                f"{journal_rotate_bytes}"
+            )
         if affinity_tokens < 1:
             raise ValueError(
                 f"affinity_tokens must be >= 1, got {affinity_tokens}"
@@ -353,7 +377,9 @@ class Router:
                             rep.fence,
                             int(plan["fences"].get(rep.index, 0)) + 1,
                         )
-            self.journal = FleetJournal(journal_path)
+            self.journal = FleetJournal(
+                journal_path, rotate_bytes=int(journal_rotate_bytes)
+            )
         for rep in self.replicas:
             rep.on_state = self._on_replica_state
 
@@ -477,10 +503,22 @@ class Router:
             return
         for frid in sorted(plan["live"]):
             ent = plan["live"][frid]
+            # Continue the ORIGINAL distributed trace, not a fresh one:
+            # the journaled trace_id re-keys this request into the same
+            # lineage tree its pre-crash spans already belong to (the
+            # root span id is fresh — the old root died unrecorded with
+            # the old router — but every grouping key matches).
+            trace = None
+            journaled_tid = ent.get("trace_id")
+            if self.tracer is not None and journaled_tid:
+                trace = RequestTrace(
+                    self.tracer.recorder, str(journaled_tid)
+                )
+                trace.finish_deferred = True
             rreq = RouterRequest(
                 int(frid), list(ent["prompt"]), int(ent["max_new"]),
                 deadline=None, submitted_s=self._clock(),
-                priority=int(ent["priority"]),
+                priority=int(ent["priority"]), trace=trace,
             )
             rreq.tokens = list(ent["tokens"])
             rreq.redrives = int(ent["redrives"])
@@ -493,10 +531,13 @@ class Router:
             if self._c_replays is not None:
                 self._c_replays.inc()
             if self.bus is not None:
+                fields = (
+                    {"trace_id": trace.trace_id} if trace is not None else {}
+                )
                 self.bus.emit(
                     "fleet_req_submit", frid=rreq.frid, replica=None,
                     n_prompt=len(rreq.prompt), max_new=rreq.max_new,
-                    priority=rreq.priority, replayed=True,
+                    priority=rreq.priority, replayed=True, **fields,
                 )
             replica: Optional[int] = None
             with rreq._lock:
@@ -592,6 +633,11 @@ class Router:
             trace = (
                 self.tracer.begin_request() if self.tracer is not None else None
             )
+        if trace is not None:
+            # The router owns the lineage-tree root: replica loops record
+            # their spans into it but must not close it — an attempt-level
+            # terminal (replica crash) is not the request's fate.
+            trace.finish_deferred = True
         engine = next(
             (r.engine for r in self.replicas if r.engine is not None), None
         )
@@ -655,6 +701,9 @@ class Router:
                 "rec": "submit", "frid": frid, "prompt": prompt,
                 "max_new": max_new, "priority": int(priority),
                 "deadline_s": deadline_s,
+                # Lineage across router restarts: a recovering router
+                # CONTINUES this trace id instead of minting an orphan.
+                "trace_id": trace.trace_id if trace is not None else None,
             })
         rreq = RouterRequest(
             frid, prompt, max_new,
@@ -665,7 +714,7 @@ class Router:
         try:
             with rreq._lock:
                 replica = self._assign_locked(rreq, exclude=set())
-        except BaseException:
+        except BaseException as e:
             if ticket is not None:
                 self.admission.release(ticket)
             if self.journal is not None:
@@ -674,6 +723,10 @@ class Router:
                 self.journal.append(
                     {"rec": "terminal", "frid": frid, "status": "rejected"}
                 )
+            # Deferred-finish means no replica loop closed the root on
+            # our behalf; the router must, or the tree never terminates.
+            if trace is not None and not trace.finished:
+                trace.finish("rejected", reason=f"placement failed: {e}")
             raise
         with self._live_lock:
             self._live[frid] = rreq
@@ -767,7 +820,11 @@ class Router:
         # decoding makes it bit-identical to the undisturbed suffix.
         prompt = rreq.prompt + rreq.tokens if delivered else rreq.prompt
         max_new = rreq.max_new - delivered
-        trace = rreq.trace if rreq.redrives == 0 else None
+        trace = (
+            rreq.trace
+            if rreq.trace is not None and not rreq.trace.finished
+            else None
+        )
         while True:
             rep = self._pick(prompt, tried)
             if rep is None:
@@ -777,15 +834,32 @@ class Router:
                     if self.admission is not None else 1.0,
                 )
             tried.add(rep.index)
+            # Every placement is a child span of the lineage root. The
+            # span id is minted BEFORE the submit so the traceparent can
+            # point at it: a remote worker parents its whole local span
+            # tree under this attempt, and in-process loops record into
+            # the same trace directly. The span itself is recorded when
+            # the attempt ends (replicas that refuse record it here).
+            span_id: Optional[str] = None
+            tp: Optional[str] = None
+            t_att0 = time.perf_counter()
+            if trace is not None:
+                span_id = trace.new_span_id()
+                tp = format_traceparent(
+                    SpanContext(trace.trace_id, span_id, sampled=True)
+                )
             try:
-                # A busy replica's loop finishes the trace "rejected" as a
-                # side effect; don't hand a finished trace to the next try.
-                t = trace if trace is not None and not trace.finished else None
                 attempt = rep.submit(
-                    prompt, max_new, deadline_s=deadline_s, trace=t,
-                    priority=rreq.priority,
+                    prompt, max_new, deadline_s=deadline_s, trace=trace,
+                    traceparent=tp, priority=rreq.priority,
                 )
             except (ReplicaUnavailable, RuntimeError) as e:
+                if trace is not None:
+                    trace.span(
+                        "req.attempt", t_att0, span_id=span_id,
+                        outcome="unavailable", replica=rep.index,
+                        redrive=rreq.redrives,
+                    )
                 last_exc = RejectedBusy(
                     str(e),
                     self.admission.retry_after_s
@@ -793,10 +867,21 @@ class Router:
                 )
                 continue
             except RejectedBusy as e:
+                if trace is not None:
+                    trace.span(
+                        "req.attempt", t_att0, span_id=span_id,
+                        outcome="busy", replica=rep.index,
+                        redrive=rreq.redrives,
+                    )
                 last_exc = e
                 continue
             rreq._attempt = attempt
             rreq.replica = rep.index
+            if trace is not None and span_id is not None:
+                rreq.attempt_span = (
+                    span_id, t_att0, rep.index,
+                    int(getattr(rep, "fence", 0)),
+                )
             threading.Thread(
                 target=self._pump,
                 args=(rreq, attempt, rep.index),
@@ -868,6 +953,22 @@ class Router:
             or reason.startswith("drain")
         )
 
+    def _close_attempt_span(
+        self, rreq: RouterRequest, outcome: str, **meta: Any
+    ) -> None:
+        """Record the open placement-attempt span (rreq._lock held):
+        the attempt is over — terminal, redrive, or abandonment — so its
+        pre-minted span id finally gets its [t0, now] extent, tagged with
+        where it ran and how it ended."""
+        ent, rreq.attempt_span = rreq.attempt_span, None
+        if ent is None or rreq.trace is None:
+            return
+        span_id, t0, rep_idx, fence = ent
+        rreq.trace.span(
+            "req.attempt", t0, span_id=span_id, outcome=outcome,
+            replica=rep_idx, fence=fence, redrive=rreq.redrives, **meta,
+        )
+
     def _redrive_locked(
         self, rreq: RouterRequest, from_idx: int, reason: str
     ) -> bool:
@@ -875,6 +976,9 @@ class Router:
         True when the request found a new home (or finished outright);
         False means the caller should deliver the failure terminal."""
         delivered = len(rreq.tokens)
+        self._close_attempt_span(
+            rreq, "redriven", reason=reason, n_committed=delivered
+        )
         # Abandon the old attempt unconditionally: every path below either
         # re-homes the request or terminates it, and a pump blocked on a
         # wedged replica's stream must be woken to exit either way.
@@ -942,12 +1046,23 @@ class Router:
         info = dict(info)
         info["redrives"] = rreq.redrives
         info["n_tokens"] = len(rreq.tokens)
+        # Which replica served the FINAL attempt — with redrives the
+        # client-visible answer crossed hosts; the gateway surfaces this
+        # alongside trace_id so a curl away from the trace tree.
+        info.setdefault("replica", rreq.replica)
         # Router-level e2e spans ALL attempts; the attempt-local timings
         # (ttft/queue_wait) describe only the last one.
         info["e2e_s"] = self._clock() - rreq.submitted_s
         if rreq.trace is not None:
             info.setdefault("trace_id", rreq.trace.trace_id)
         rreq.info = info
+        # Close the lineage tree: the last attempt span, then the root
+        # (replica loops saw finish_deferred and left it open for us).
+        self._close_attempt_span(rreq, status)
+        if rreq.trace is not None and not rreq.trace.finished:
+            rreq.trace.finish(
+                status, n_tokens=len(rreq.tokens), redrives=rreq.redrives
+            )
         if self.admission is not None and rreq.ticket is not None:
             self.admission.release(rreq.ticket)
         with self._live_lock:

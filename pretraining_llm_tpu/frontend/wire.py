@@ -24,6 +24,16 @@ import struct
 import time
 from typing import Any, Dict, Optional
 
+# Protocol revision spoken by this build. Exchanged in the hello (each
+# side sends its own; the reply echoes the worker's), so new frame kinds
+# are NEGOTIABLE: a sender only emits a frame the peer's advertised
+# version understands, instead of crashing an old peer on an unknown op.
+# A peer whose hello carries no ``proto`` field is version 1.
+#   1  original op set (hello/submit/cancel/.../stall + token/end/event)
+#   2  adds the batched span-export frame ({"op": "spans", ...}) and
+#      clock samples in hello/health replies
+PROTO_VERSION = 2
+
 # A frame is one JSON op or one token batch — 64 MiB means a corrupt
 # length prefix fails fast instead of attempting a multi-GB recv.
 MAX_FRAME_BYTES = 64 * 1024 * 1024

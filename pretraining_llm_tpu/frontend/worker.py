@@ -96,7 +96,15 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from .wire import ConnectionLost, ProtocolError, recv_frame, send_frame
+from ..observability.spans import SpanRecorder
+from ..observability.tracing import Tracer
+from .wire import (
+    PROTO_VERSION,
+    ConnectionLost,
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
 
 _ORPHAN_DRAIN_S = 10.0
 
@@ -203,6 +211,24 @@ class WorkerServer:
         # before an eject stays distinguishable after a heal/re-attach.
         self._attempts: Dict[int, Any] = {}
         self.replica = None  # set in start_replica()
+
+        # Cross-process tracing: the worker records the SAME engine span
+        # set an in-process replica would (queue/prefill/window/...) into
+        # a local recorder, then ships them to the router in batched
+        # ``spans`` frames after each stream ends. sample=0.0 means the
+        # worker NEVER originates a trace of its own — it only joins
+        # traces the router propagates via ``traceparent`` on submit
+        # (begin_request honors the inbound sampled flag verbatim). Each
+        # process has its own perf_counter epoch; the parent's clock
+        # estimator maps these timestamps into its own timeline.
+        self.recorder = SpanRecorder(
+            max_events=int(spec.get("trace_buffer", 20000))
+        )
+        self.tracer = Tracer(self.recorder, sample=0.0, seed=self.index)
+        # Wire protocol version of the CURRENTLY connected peer (learned
+        # from its hello; absent field = v1). Spans frames are only sent
+        # to peers that advertised v2+.
+        self._peer_proto = 1
 
         # Fencing + lease state (attach mode; inert for spawned children
         # until a hello grants a lease).
@@ -382,6 +408,7 @@ class WorkerServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            self._peer_proto = 1  # until this connection's hello says more
             with self._wlock:
                 self._conn = conn
                 buffered, self._event_buf = self._event_buf, []
@@ -459,6 +486,7 @@ class WorkerServer:
         loop = rep.loop
         if op == "hello":
             self._adopt_lease(req)
+            self._peer_proto = int(req.get("proto", 1))
             eng = loop.engine
             self._send(
                 {
@@ -482,6 +510,13 @@ class WorkerServer:
                         "fence": self._fence,
                         "lease_s": self._lease_s,
                         "lease_expiries": self._lease_expiries,
+                        # Protocol negotiation + clock alignment: the
+                        # parent only sends/expects v2 frames if this
+                        # advertises >= 2, and feeds the clock sample
+                        # (our perf_counter epoch) into its min-RTT
+                        # offset estimator.
+                        "proto": PROTO_VERSION,
+                        "clock": time.perf_counter(),
                     },
                 }
             )
@@ -544,14 +579,26 @@ class WorkerServer:
         # The PARENT assigns the stream id: it registers the attempt
         # before sending, so a token frame can never race the reply.
         wrid = int(req.get("rid", 0))
+        # A submit carrying ``traceparent`` joins the router's trace: the
+        # local RequestTrace inherits the trace id and parents its root
+        # under the router's placement-attempt span, so the worker's
+        # queue/prefill/window spans nest inside the fleet lineage tree
+        # once exported. No header -> local tracing stays off (the
+        # worker's own sample rate is 0).
+        tp = req.get("traceparent")
+        trace_kw: Dict[str, Any] = {}
+        if tp is not None:
+            trace_kw["trace"] = self.tracer.begin_request(str(tp))
         try:
             if lane == "loop":
                 attempt = rep.loop.submit(
-                    prompt, max_new, deadline_s=deadline_s, priority=priority
+                    prompt, max_new, deadline_s=deadline_s,
+                    priority=priority, **trace_kw
                 )
             else:
                 attempt = rep.submit(
-                    prompt, max_new, deadline_s=deadline_s, priority=priority
+                    prompt, max_new, deadline_s=deadline_s,
+                    priority=priority, **trace_kw
                 )
         except ValueError as e:
             self._send({"id": rid, "error": "invalid", "message": str(e)})
@@ -608,8 +655,35 @@ class WorkerServer:
                         },
                         g=g,
                     )
+                    self._export_spans(g)
         finally:
             self._attempts.pop(wrid, None)
+
+    def _export_spans(self, g: int) -> None:
+        """Ship every span completed since the last export in one
+        batched frame (piggybacked on stream ends — the recorder only
+        holds COMPLETED spans, so concurrent in-flight requests lose
+        nothing; their spans ride a later batch). Gated on the peer's
+        advertised protocol version: a v1 router would treat the frame
+        as garbage. The drop count is a delta the parent feeds into a
+        monotonic counter — a saturated worker buffer is visible, never
+        silent."""
+        if self._peer_proto < 2:
+            return
+        events, dropped = self.recorder.drain()
+        if not events and not dropped:
+            return
+        self._send(
+            {
+                "op": "spans",
+                "spans": [
+                    {"name": name, "t0": t0, "dur": dur, "meta": meta}
+                    for name, t0, dur, _tid, _depth, meta in events
+                ],
+                "dropped": dropped,
+            },
+            g=g,
+        )
 
     def _adopt_lease(self, req: Dict[str, Any]) -> None:
         fence = req.get("fence")
@@ -665,6 +739,9 @@ class WorkerServer:
             "weight_fingerprint": loop.weight_fingerprint,
             "lease_expiries": self._lease_expiries,
             "fence": self._fence,
+            # Heartbeat clock sample: re-read on every health poll so the
+            # parent's offset estimator tracks drift continuously.
+            "clock": time.perf_counter(),
         }
 
     def _exit_clean(self) -> None:

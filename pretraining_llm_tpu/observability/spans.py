@@ -90,6 +90,17 @@ class SpanRecorder:
             else:
                 self._dropped += 1
 
+    def drain(self) -> Tuple[List[Tuple[str, float, float, int, int, Dict[str, Any]]], int]:
+        """Pop every recorded span plus the drop count accumulated since
+        the last drain. This is the worker-side export path: the bounded
+        ``_events`` list doubles as the span-export buffer, spans ship
+        exactly once, and resetting the drop counter makes the returned
+        count an increment the parent can feed a monotonic counter."""
+        with self._lock:
+            events, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        return events, dropped
+
     # -- aggregate views ----------------------------------------------
 
     def summary(self) -> Dict[str, Dict[str, float]]:

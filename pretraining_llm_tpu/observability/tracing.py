@@ -87,6 +87,7 @@ class RequestTrace:
     __slots__ = (
         "trace_id", "root_id", "parent_id", "marks", "t0",
         "_recorder", "_track", "_rng", "_finished", "_lock",
+        "finish_deferred",
     )
 
     def __init__(
@@ -106,20 +107,41 @@ class RequestTrace:
         self.t0 = time.perf_counter()
         self.marks: Dict[str, float] = {"start": self.t0}
         self._finished = False
+        # When True, the terminal paths that normally finish() the trace
+        # (EngineLoop._terminal / _rejected) record their spans but leave
+        # the root open — the fleet router owns the root of a lineage
+        # tree and finishes it exactly once, after redrives settle.
+        self.finish_deferred = False
         self._lock = threading.Lock()
 
     def _new_span_id(self) -> str:
         return f"{self._rng.getrandbits(64) or 1:016x}"
+
+    def new_span_id(self) -> str:
+        """Mint a span id under this trace's RNG — used by the router to
+        pre-allocate a placement-attempt span id so it can hand workers a
+        ``traceparent`` pointing AT the attempt before the attempt span
+        itself is recorded (spans are written at completion)."""
+        return self._new_span_id()
 
     @property
     def context(self) -> SpanContext:
         return SpanContext(self.trace_id, self.root_id, sampled=True)
 
     def span(
-        self, name: str, t0: float, t1: Optional[float] = None, **meta: Any
+        self,
+        name: str,
+        t0: float,
+        t1: Optional[float] = None,
+        *,
+        span_id: Optional[str] = None,
+        **meta: Any,
     ) -> None:
         """Record one completed child span [t0, t1] (perf_counter
-        seconds); ``t1`` defaults to now."""
+        seconds); ``t1`` defaults to now. ``span_id`` lets a caller that
+        pre-allocated the id (``new_span_id``, the router's attempt
+        spans) record under it so grandchildren minted earlier still
+        parent correctly."""
         end = time.perf_counter() if t1 is None else t1
         self._recorder.record(
             name,
@@ -127,7 +149,7 @@ class RequestTrace:
             max(0.0, end - t0),
             meta={
                 "trace_id": self.trace_id,
-                "span_id": self._new_span_id(),
+                "span_id": span_id if span_id is not None else self._new_span_id(),
                 "parent_span_id": self.root_id,
                 **meta,
             },
@@ -135,8 +157,11 @@ class RequestTrace:
         )
 
     def event(self, name: str, **meta: Any) -> None:
-        """Zero-duration child span (a point on the waterfall)."""
-        self.span(name, time.perf_counter(), time.perf_counter(), **meta)
+        """Zero-duration child span (a point on the waterfall). One
+        clock read serves as both endpoints — two reads would make the
+        instant negative-width after the exporter's subtraction."""
+        now = time.perf_counter()
+        self.span(name, now, now, **meta)
 
     def finish(self, status: str, **meta: Any) -> bool:
         """Record the terminal point and the root request span (t0 ->

@@ -1142,3 +1142,175 @@ python scripts/obs_report.py --integrity --strict \
     > "$OBS_TMP/quant_integrity_report.out"
 grep -q "detected by" "$OBS_TMP/quant_integrity_report.out" || {
     echo "obs_report --integrity missing the detection attribution (quantized)"; exit 1; }
+
+# Cross-host tracing gate: the distributed-tracing wiring over a REAL
+# process boundary. Two pre-spawned TCP workers (proto v2: clock samples
+# in hello/heartbeat, batched span-export frames) attach behind a traced
+# router; replica 0 is partitioned mid-burst so one request is redriven
+# across hosts. The router recorder must end up holding ONE merged
+# Chrome trace: worker decode spans clock-aligned into the router
+# timeline (offset from the min-RTT estimator, error bound recorded on
+# every ingested span) and nested under the owning req.attempt span of
+# the router's lineage tree; terminal bodies must carry replica +
+# redrives next to trace_id; /metrics must stay lint-clean with the
+# span/drop counters and clock gauges; and the offline analyzer must
+# accept the artifacts with --fleet-trace --strict.
+JAX_PLATFORMS=cpu python -m pretraining_llm_tpu.frontend.worker \
+    --spec-json "$MH_SPEC" --listen 127.0.0.1:0 --token trace-smoke-token \
+    > "$OBS_TMP/tr_worker0.out" 2> "$OBS_TMP/tr_worker0.err" &
+TR_W0=$!
+JAX_PLATFORMS=cpu python -m pretraining_llm_tpu.frontend.worker \
+    --spec-json "$MH_SPEC" --listen 127.0.0.1:0 --token trace-smoke-token \
+    > "$OBS_TMP/tr_worker1.out" 2> "$OBS_TMP/tr_worker1.err" &
+TR_W1=$!
+TR_ADDR0="127.0.0.1:$(mh_port "$OBS_TMP/tr_worker0.out")"
+TR_ADDR1="127.0.0.1:$(mh_port "$OBS_TMP/tr_worker1.out")"
+
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" TR_ADDR0="$TR_ADDR0" \
+    TR_ADDR1="$TR_ADDR1" python - <<'EOF'
+import json, os, time, urllib.request
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.loadgen import LoadSpec, run_http
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+from pretraining_llm_tpu.observability.spans import SpanRecorder
+from pretraining_llm_tpu.observability.tracing import Tracer
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+
+tmp = os.environ["OBS_TMP"]
+bus = EventBus(os.path.join(tmp, "fleet_trace_events.jsonl"))
+faults = ServingFaultInjector("partition@req2:r0", bus=bus)
+registry = MetricsRegistry("pllm_serving_")
+# ONE recorder for the whole fleet: the router's own spans and every
+# worker's exported spans land in the same buffer, so a single export
+# at the end IS the merged cross-host trace.
+recorder = SpanRecorder(max_events=50000)
+tracer = Tracer(recorder, sample=1.0, seed=17)
+spec = {
+    "preset": "tiny",
+    "init_seed": 0,
+    "model_overrides": {"compute_dtype": "float32"},
+    "engine": {"max_batch": 2, "n_blocks": 24, "block_size": 8,
+               "temperature": 0.0, "steps_per_sched": 4,
+               "pipeline_depth": 2},
+    "admission": {"max_queue_depth": 8},
+}
+replicas = []
+for i in range(2):
+    s = dict(spec)
+    s["attach"] = os.environ[f"TR_ADDR{i}"]
+    s["token"] = "trace-smoke-token"
+    replicas.append(RemoteReplica(i, s, bus=bus, fault_injector=faults,
+                                  lease_s=0.8, recorder=recorder))
+router = Router(replicas, bus=bus, registry=registry, tracer=tracer,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=60.0).start()
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+load = LoadSpec(n_requests=12, mode="closed", concurrency=4, seed=9,
+                vocab_size=replicas[0].engine.cfg.vocab_size,
+                max_new_min=6, max_new_max=10, send_traceparent=True)
+report = run_http(base, load)
+
+lost = load.n_requests - len(report.outcomes)
+assert lost == 0, f"{lost} requests lost"
+statuses = {}
+for o in report.outcomes:
+    statuses[o.status] = statuses.get(o.status, 0) + 1
+    assert o.trace_id, f"request {o.index} lost its trace id: {o}"
+assert statuses == {"done": 12}, statuses
+assert report.summary()["redrives_total"] >= 1, report.summary()
+assert replicas[0].fence >= 1, "fence generation never bumped"
+assert all(rep._peer_proto >= 2 for rep in replicas), \
+    [rep._peer_proto for rep in replicas]
+
+# Terminal bodies carry the lineage summary next to the trace id.
+req = urllib.request.Request(
+    f"{base}/v1/generate",
+    data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4}).encode(),
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=120) as r:
+    body = json.loads(r.read())
+assert body["status"] == "done" and body.get("trace_id"), body
+assert "replica" in body and "redrives" in body, body
+
+# Span export piggybacks on stream ends — wait for the survivor's
+# batches to settle before snapshotting the merged trace.
+deadline = time.monotonic() + 30.0
+last = -1.0
+while time.monotonic() < deadline:
+    cur = replicas[1]._c_spans.value
+    if cur > 0 and cur == last:
+        break
+    last = cur
+    time.sleep(0.5)
+assert replicas[1]._c_spans.value > 0, "survivor exported no spans"
+
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_worker_spans_total" in text, text[:400]
+assert "pllm_serving_worker_span_drops_total" in text, text[:400]
+assert "pllm_serving_clock_offset_seconds" in text, text[:400]
+assert "pllm_serving_clock_error_bound_seconds" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+recorder.export(os.path.join(tmp, "fleet_trace.json"))
+
+# The merged trace: worker subtrees clock-aligned and nested under the
+# router's attempt spans, with at least one redriven lineage tree.
+with open(os.path.join(tmp, "fleet_trace.json")) as f:
+    events = json.load(f)["traceEvents"]
+spans = [e for e in events
+         if e.get("ph") == "X" and (e.get("args") or {}).get("trace_id")]
+remote = [e for e in spans if e["args"].get("remote")]
+assert remote, "no worker spans reached the router recorder"
+assert not any(e["args"].get("unaligned") for e in remote), \
+    "worker spans ingested without a clock offset estimate"
+assert all(e["args"].get("clock_err_s") is not None
+           and float(e["args"]["clock_err_s"]) < 0.25 for e in remote), \
+    "ingested worker span missing a sane clock error bound"
+assert any(e["name"] == "req.window" for e in remote), \
+    "no worker decode window in the merged trace"
+by_trace = {}
+for e in spans:
+    by_trace.setdefault(e["args"]["trace_id"], []).append(e)
+nested = 0
+for tid, grp in by_trace.items():
+    attempts = {e["args"].get("span_id") for e in grp
+                if e["name"] == "req.attempt" and not e["args"].get("remote")}
+    for e in grp:
+        if e["args"].get("remote") and e["name"] == "req.request":
+            assert e["args"].get("parent_span_id") in attempts, (tid, e)
+            nested += 1
+assert nested >= 1, "no worker subtree nested under a router attempt"
+redriven = [e for e in spans
+            if e["name"] == "req.request" and not e["args"].get("remote")
+            and int(e["args"].get("redrives") or 0) >= 1]
+assert redriven, "no redriven lineage tree in the merged trace"
+print(f"cross-host tracing smoke ok: {statuses}, "
+      f"{len(remote)} worker spans ({nested} subtrees), "
+      f"{len(redriven)} redriven trees, dropped={recorder.dropped}")
+EOF
+
+kill "$TR_W0" "$TR_W1" 2>/dev/null || true
+wait "$TR_W0" "$TR_W1" 2>/dev/null || true
+
+# The offline analyzer must accept the cross-host artifacts with
+# --fleet-trace --strict: every worker span clock-aligned into its
+# attempt window, every subtree parented into its lineage tree, and the
+# per-request cross-host decomposition summing to e2e.
+python scripts/obs_report.py --fleet-trace --strict \
+    "$OBS_TMP/fleet_trace_events.jsonl" --trace "$OBS_TMP/fleet_trace.json" \
+    > "$OBS_TMP/fleet_trace_report.out"
+grep -q "== fleet trace ==" "$OBS_TMP/fleet_trace_report.out" || {
+    echo "obs_report --fleet-trace missing the fleet trace section"; exit 1; }
+grep -Eq "redriven=[1-9]" "$OBS_TMP/fleet_trace_report.out" || {
+    echo "obs_report --fleet-trace saw no redriven lineage tree"; exit 1; }

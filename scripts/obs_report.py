@@ -52,6 +52,19 @@ router restart recovering from its fleet journal. Under ``--strict`` a
 lost request, dangling redrive, or UNDETECTED partition is fatal, which
 is the CI fleet gate.
 
+``--fleet-trace`` adds the CROSS-HOST view over the same ``--trace``
+export: each request becomes a lineage tree — the router's root span,
+one ``req.attempt`` child per placement attempt (tagged replica + fence
+generation + redrive index), and per-attempt worker subtrees shipped
+over the span-export frame and clock-aligned into the router timeline by
+the per-connection min-RTT offset estimator. The per-request waterfall
+decomposes e2e ACROSS attempts (placement / attempts / redrive gaps /
+finish, summing to the root), and inter-attempt gaps are joined to the
+``redrive``/``lease_expired`` events that explain them. ``--strict``
+fails unalignable spans, orphaned attempts or subtrees, and worker spans
+outside their attempt's window beyond the recorded clock error bound,
+which is the CI cross-host tracing gate.
+
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
 """
@@ -220,26 +233,44 @@ def _union_s(intervals: List[Tuple[float, float]]) -> float:
     return total / 1e6
 
 
+_ATTEMPT = "req.attempt"
+
+
+def _is_remote(ev: Dict[str, Any]) -> bool:
+    return bool((ev.get("args") or {}).get("remote"))
+
+
 def check_trace_tree(trace_id: str, spans: List[Dict[str, Any]]) -> List[str]:
     """Structural completeness for ONE request's span tree; returns
     problems (empty = complete). What 'complete' means depends on how the
     request ended: a done request must show the whole journey (queue,
     prefill, at least one decode window, first token, terminal); a
     rejected one only its admission verdict; cancelled/expired/error at
-    minimum the queue time they burned before dying."""
+    minimum the queue time they burned before dying.
+
+    Cross-host traces are three-level: the ROUTER owns the root and the
+    terminal, each placement attempt is a ``req.attempt`` child, and a
+    worker that served an attempt contributes its own subtree — a
+    ``remote`` ``req.request`` parented to the attempt's span_id, with the
+    engine spans (queue/prefill/window/first_token) under it. So remote
+    spans are exempt from the parented-to-root rule (they parent through
+    their attempt) but still count toward the journey: a done request's
+    prefill may live on the worker, not the router."""
     problems: List[str] = []
     short = trace_id[:12]
+    local = [ev for ev in spans if not _is_remote(ev)]
+    remote = [ev for ev in spans if _is_remote(ev)]
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for ev in spans:
         by_name.setdefault(ev["name"], []).append(ev)
-    roots = by_name.get(_ROOT, [])
+    roots = [ev for ev in local if ev["name"] == _ROOT]
     if len(roots) != 1:
         problems.append(f"trace {short}: {len(roots)} root spans (want 1)")
         return problems  # nothing else is checkable without the root
     root = roots[0]
     root_sid = root["args"].get("span_id")
     status = root["args"].get("status")
-    terminals = by_name.get(_TERMINAL, [])
+    terminals = [ev for ev in local if ev["name"] == _TERMINAL]
     if len(terminals) != 1:
         problems.append(f"trace {short}: {len(terminals)} terminal events (want 1)")
     elif terminals[0]["args"].get("status") != status:
@@ -247,13 +278,40 @@ def check_trace_tree(trace_id: str, spans: List[Dict[str, Any]]) -> List[str]:
             f"trace {short}: terminal status "
             f"{terminals[0]['args'].get('status')!r} != root {status!r}"
         )
-    for ev in spans:
+    for ev in local:
         if ev is root:
             continue
         if ev["args"].get("parent_span_id") != root_sid:
             problems.append(
                 f"trace {short}: {ev['name']} span not parented to root"
             )
+    # Worker subtrees: each remote root must hang off one of THIS trace's
+    # attempt spans; every other remote span must hang off a remote root.
+    # (A redriven worker-side attempt keeps its own local terminal status
+    # — only the ROUTER's terminal speaks for the request, so remote
+    # statuses are not cross-checked here.)
+    attempt_ids = {
+        ev["args"].get("span_id") for ev in local if ev["name"] == _ATTEMPT
+    }
+    remote_root_ids = {
+        ev["args"].get("span_id") for ev in remote if ev["name"] == _ROOT
+    }
+    for ev in remote:
+        parent = ev["args"].get("parent_span_id")
+        if ev["name"] == _ROOT:
+            if parent not in attempt_ids:
+                problems.append(
+                    f"trace {short}: worker subtree (replica "
+                    f"{ev['args'].get('worker')}) not parented to any "
+                    f"req.attempt span"
+                )
+        elif parent not in remote_root_ids:
+            # A stray child whose subtree root never arrived (the root
+            # dies with a fenced partition while earlier export batches
+            # already shipped the child) is honest loss, tolerated — but
+            # the journey check below still requires the SURVIVING
+            # attempt's subtree to be whole.
+            continue
     need = {
         "done": ("req.queue", "req.prefill", "req.window",
                  "req.first_token", _TERMINAL),
@@ -276,8 +334,16 @@ def request_waterfall(trace_id: str, spans: List[Dict[str, Any]]) -> Dict[str, A
     exactly: decode is the UNION of the (possibly overlapping) window
     intervals, host_blocked is carved out of it from the per-window
     ``host_blocked_s`` meta, and ``other`` is the residual no child span
-    claims (scheduler turnaround, token reap-to-notify, SSE write)."""
-    root = next(ev for ev in spans if ev["name"] == _ROOT)
+    claims (scheduler turnaround, token reap-to-notify, SSE write).
+
+    Cross-host traces decompose the same way: the ROUTER's root anchors
+    e2e, and a worker's clock-aligned queue/prefill/window spans fill the
+    segments exactly as in-process ones would (they are clipped to the
+    root, so any clock-mapping slop at the edges cannot break the
+    sums-to-e2e contract)."""
+    root = next(
+        ev for ev in spans if ev["name"] == _ROOT and not _is_remote(ev)
+    )
     r0, r1 = root["ts"], root["ts"] + root["dur"]
 
     def clipped(name: str) -> List[Tuple[float, float]]:
@@ -353,7 +419,7 @@ def build_slo_report(
     for trace_id, spans in sorted(groups.items()):
         ps = check_trace_tree(trace_id, spans)
         problems.extend(ps)
-        if any(ev["name"] == _ROOT for ev in spans):
+        if any(ev["name"] == _ROOT and not _is_remote(ev) for ev in spans):
             waterfalls.append(request_waterfall(trace_id, spans))
     waterfalls.sort(key=lambda w: w["e2e_s"], reverse=True)
 
@@ -443,6 +509,274 @@ def print_slo_report(report: Dict[str, Any]) -> None:
             )
     elif report["slo"]["ttft_s"] or report["slo"]["e2e_s"]:
         print("== slo misses: none ==")
+    for p in report["problems"]:
+        print(f"!! {p}")
+
+
+# -- cross-host trace analysis (--fleet-trace) ------------------------------
+
+# Slack added to each span's recorded clock error bound when checking
+# containment: covers send/receive latency between the router stamping the
+# attempt edges and the worker stamping its own spans.
+_ALIGN_SLACK_S = 0.002
+
+
+def build_fleet_trace_report(
+    trace: Dict[str, Any],
+    events: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Fold a merged cross-host trace into the fleet-trace view.
+
+    Each request is a LINEAGE TREE: the router's root span anchors e2e,
+    every placement attempt is a ``req.attempt`` child tagged (replica,
+    fence, redrive), and a worker that served an attempt contributes a
+    clock-aligned subtree ingested over the span-export frame. The
+    decomposition here is ACROSS attempts and sums to the root e2e by
+    construction: placement (root start to first attempt), the union of
+    attempt intervals, inter-attempt gaps (the redrive/partition-detection
+    dead time — joined to ``redrive``/``lease_expired`` events when an
+    events JSONL rides along), and finish (last attempt to terminal).
+
+    Problems (all strict): a span the ingester could not clock-align
+    (``unaligned`` meta — no offset estimate existed yet), a worker
+    subtree root orphaned from its attempt, a worker span lying outside
+    its attempt's window by more than its recorded clock error bound
+    (+ a small send-latency slack), a worker-span group with no router
+    root at all, and an attempt-union/e2e sum error > 1%. Remote CHILD
+    spans whose subtree root never arrived are honest loss, not a lie —
+    a partitioned worker's root dies with the fenced connection while
+    earlier export batches already shipped some children — so they are
+    counted (``n_stray_spans``), excluded from alignment, never strict.
+    """
+    groups = group_request_spans(trace)
+    events = events or []
+    redrive_ev = [e for e in events if e.get("event") == "redrive"]
+    lease_ev = [e for e in events if e.get("event") == "lease_expired"]
+    problems: List[str] = []
+    requests: List[Dict[str, Any]] = []
+    n_worker_spans = 0
+    n_unaligned = 0
+    n_stray_spans = 0
+    max_clock_err_s = 0.0
+    for trace_id, spans in sorted(groups.items()):
+        short = trace_id[:12]
+        local = [ev for ev in spans if not _is_remote(ev)]
+        remote = [ev for ev in spans if _is_remote(ev)]
+        n_worker_spans += len(remote)
+        for ev in remote:
+            err = ev["args"].get("clock_err_s")
+            if err is not None:
+                max_clock_err_s = max(max_clock_err_s, float(err))
+            if ev["args"].get("unaligned"):
+                n_unaligned += 1
+                problems.append(
+                    f"trace {short}: unalignable worker span "
+                    f"{ev['name']!r} (replica {ev['args'].get('worker')}: "
+                    f"no clock offset estimate at ingest)"
+                )
+        roots = [ev for ev in local if ev["name"] == _ROOT]
+        if len(roots) != 1:
+            problems.append(
+                f"trace {short}: {len(roots)} router root spans (want 1) "
+                f"— worker spans cannot join a lineage tree"
+            )
+            continue
+        root = roots[0]
+        r0, r1 = float(root["ts"]), float(root["ts"]) + float(root["dur"])
+        e2e_s = float(root["dur"]) / 1e6
+        attempts = sorted(
+            (ev for ev in local if ev["name"] == _ATTEMPT),
+            key=lambda ev: float(ev["ts"]),
+        )
+        attempt_by_sid = {ev["args"].get("span_id"): ev for ev in attempts}
+
+        # Worker subtree -> owning attempt (remote roots parent to the
+        # attempt's span_id; other remote spans parent to a remote root).
+        subtree_attempt: Dict[Any, Dict[str, Any]] = {}
+        for ev in remote:
+            if ev["name"] != _ROOT:
+                continue
+            att = attempt_by_sid.get(ev["args"].get("parent_span_id"))
+            if att is None:
+                problems.append(
+                    f"trace {short}: worker subtree (replica "
+                    f"{ev['args'].get('worker')}) orphaned — its parent "
+                    f"attempt span is missing from the tree"
+                )
+            else:
+                subtree_attempt[ev["args"].get("span_id")] = att
+
+        def _owning_attempt(ev: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+            if ev["name"] == _ROOT:
+                return subtree_attempt.get(ev["args"].get("span_id"))
+            return subtree_attempt.get(ev["args"].get("parent_span_id"))
+
+        # Clock-alignment acceptance: every aligned worker span must lie
+        # inside its attempt's window, within the error bound recorded at
+        # ingest (the live min-RTT estimate) plus the send-latency slack.
+        for ev in remote:
+            if ev["args"].get("unaligned"):
+                continue
+            att = _owning_attempt(ev)
+            if att is None:
+                if ev["name"] != _ROOT:
+                    # Stray child: its subtree root never arrived (lost
+                    # behind a fenced partition after earlier batches
+                    # shipped the child) — counted, not strict.
+                    n_stray_spans += 1
+                continue
+            tol_us = (
+                float(ev["args"].get("clock_err_s", 0.0)) + _ALIGN_SLACK_S
+            ) * 1e6
+            a0, a1 = float(att["ts"]), float(att["ts"]) + float(att["dur"])
+            if (float(ev["ts"]) < a0 - tol_us
+                    or float(ev["ts"]) + float(ev["dur"]) > a1 + tol_us):
+                problems.append(
+                    f"trace {short}: worker span {ev['name']!r} lies "
+                    f"outside its attempt window by more than the clock "
+                    f"error bound ({ev['args'].get('clock_err_s', 0.0)}s)"
+                )
+
+        # Cross-attempt decomposition: merge the (clipped) attempt
+        # intervals, then placement/attempts/gaps/finish sum to e2e.
+        ivs = sorted(
+            (max(float(ev["ts"]), r0),
+             min(float(ev["ts"]) + float(ev["dur"]), r1))
+            for ev in attempts
+            if float(ev["ts"]) < r1 and float(ev["ts"]) + float(ev["dur"]) > r0
+        )
+        merged: List[List[float]] = []
+        for s, e in ivs:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        attempts_s = sum(e - s for s, e in merged) / 1e6
+        placement_s = (merged[0][0] - r0) / 1e6 if merged else e2e_s
+        finish_s = (r1 - merged[-1][1]) / 1e6 if merged else 0.0
+        gaps: List[Dict[str, Any]] = []
+        for (_, g0), (g1, _) in zip(merged, merged[1:]):
+            causes = []
+            for ev in redrive_ev:
+                if (ev.get("trace_id") == trace_id
+                        and g0 / 1e6 - 0.5 <= float(ev.get("t_wall", 0.0))
+                        <= g1 / 1e6 + 0.5):
+                    causes.append(f"redrive:{ev.get('reason', '?')}")
+            for ev in lease_ev:
+                if g0 / 1e6 - 0.5 <= float(ev.get("t_wall", 0.0)) \
+                        <= g1 / 1e6 + 0.5:
+                    causes.append(
+                        f"partition_detect:lease_expired"
+                        f"(replica {ev.get('replica')})"
+                    )
+            gaps.append({
+                "t_rel_s": (g0 - r0) / 1e6,
+                "dur_s": (g1 - g0) / 1e6,
+                "causes": causes,
+            })
+        gap_s = sum(g["dur_s"] for g in gaps)
+        sum_error_s = (placement_s + attempts_s + gap_s + finish_s) - e2e_s
+        if e2e_s > 0 and abs(sum_error_s) > 0.01 * e2e_s:
+            problems.append(
+                f"trace {short}: cross-host segments sum to "
+                f"{placement_s + attempts_s + gap_s + finish_s:.4f}s but "
+                f"e2e is {e2e_s:.4f}s (error > 1%)"
+            )
+
+        att_rows = []
+        for ev in attempts:
+            sid = ev["args"].get("span_id")
+            sub = [
+                rv for rv in remote
+                if _owning_attempt(rv) is attempt_by_sid.get(sid)
+            ]
+            att_rows.append({
+                "outcome": ev["args"].get("outcome"),
+                "replica": ev["args"].get("replica"),
+                "fence": ev["args"].get("fence"),
+                "redrive": ev["args"].get("redrive"),
+                "t_rel_s": (float(ev["ts"]) - r0) / 1e6,
+                "dur_s": float(ev["dur"]) / 1e6,
+                "worker_spans": len(sub),
+                "worker_decode_s": _union_s([
+                    (float(rv["ts"]), float(rv["ts"]) + float(rv["dur"]))
+                    for rv in sub if rv["name"] == "req.window"
+                ]),
+                "clock_err_s": max(
+                    (float(rv["args"].get("clock_err_s", 0.0))
+                     for rv in sub), default=None,
+                ) if sub else None,
+            })
+        requests.append({
+            "trace_id": trace_id,
+            "status": root["args"].get("status"),
+            "redrives": root["args"].get("redrives"),
+            "e2e_s": e2e_s,
+            "segments": {
+                "placement_s": placement_s,
+                "attempts_s": attempts_s,
+                "redrive_gap_s": gap_s,
+                "finish_s": finish_s,
+            },
+            "sum_error_s": sum_error_s,
+            "attempts": att_rows,
+            "gaps": gaps,
+        })
+    requests.sort(key=lambda r: r["e2e_s"], reverse=True)
+    return {
+        "n_requests": len(requests),
+        "n_attempts": sum(len(r["attempts"]) for r in requests),
+        "n_worker_spans": n_worker_spans,
+        "n_unaligned": n_unaligned,
+        "n_stray_spans": n_stray_spans,
+        "max_clock_err_s": max_clock_err_s,
+        "redriven_requests": sum(
+            1 for r in requests if len(r["attempts"]) > 1
+        ),
+        "requests": requests,
+        "problems": problems,
+    }
+
+
+def print_fleet_trace_report(report: Dict[str, Any]) -> None:
+    print("== fleet trace ==")
+    print(
+        f"requests={report['n_requests']} attempts={report['n_attempts']} "
+        f"worker_spans={report['n_worker_spans']} "
+        f"unaligned={report['n_unaligned']} "
+        f"stray={report['n_stray_spans']} "
+        f"max_clock_err={report['max_clock_err_s'] * 1e3:.3f}ms "
+        f"redriven={report['redriven_requests']}"
+    )
+    for r in report["requests"][:20]:
+        seg = r["segments"]
+        print(
+            f"  {r['trace_id'][:12]:<12} {r['status'] or '?':<9} "
+            f"e2e={r['e2e_s']:.3f}s placement={seg['placement_s']:.4f}s "
+            f"attempts={seg['attempts_s']:.4f}s "
+            f"gaps={seg['redrive_gap_s']:.4f}s "
+            f"finish={seg['finish_s']:.4f}s "
+            f"(err={r['sum_error_s']:+.4f}s)"
+        )
+        for a in r["attempts"]:
+            err = (
+                f" clock_err={a['clock_err_s'] * 1e3:.3f}ms"
+                if a["clock_err_s"] is not None else ""
+            )
+            print(
+                f"    attempt r{a['replica']} g{a['fence']} "
+                f"#{a['redrive']}: {a['outcome'] or '?':<11} "
+                f"+{a['t_rel_s']:.4f}s {a['dur_s']:.4f}s "
+                f"worker_spans={a['worker_spans']} "
+                f"decode={a['worker_decode_s']:.4f}s{err}"
+            )
+        for g in r["gaps"]:
+            why = " ".join(g["causes"]) or "?"
+            print(
+                f"    gap +{g['t_rel_s']:.4f}s {g['dur_s']:.4f}s <- {why}"
+            )
+    if len(report["requests"]) > 20:
+        print(f"  ... {len(report['requests']) - 20} more")
     for p in report["problems"]:
         print(f"!! {p}")
 
@@ -1455,6 +1789,15 @@ def main() -> int:
         help="end-to-end SLO bound in seconds (0 = no bound)",
     )
     parser.add_argument(
+        "--fleet-trace", dest="fleet_trace", action="store_true",
+        help="cross-host lineage view from --trace (+ optional events "
+        "JSONL): per-request waterfall across placement attempts (sums "
+        "to e2e), worker subtrees clock-aligned into the router "
+        "timeline, redrive/partition-detection gaps; --strict makes an "
+        "unalignable span, an orphaned attempt/subtree, an "
+        "out-of-bound worker span, or a >1%% sum error fatal",
+    )
+    parser.add_argument(
         "--capacity", action="store_true",
         help="capacity attribution from cap_window/decision events: "
         "slot-second waterfall (sums to wall time), binding constraint, "
@@ -1481,6 +1824,8 @@ def main() -> int:
     args = parser.parse_args()
     if args.slo and not args.trace:
         parser.error("--slo needs --trace")
+    if args.fleet_trace and not args.trace:
+        parser.error("--fleet-trace needs --trace")
     if args.capacity and not args.paths:
         parser.error("--capacity needs events JSONL paths")
     if args.fleet and not args.paths:
@@ -1505,6 +1850,11 @@ def main() -> int:
             trace, slo_ttft_s=args.slo_ttft_s, slo_e2e_s=args.slo_e2e_s
         )
         report["serving"] = slo_report
+    fleet_trace_report: Optional[Dict[str, Any]] = None
+    if args.fleet_trace:
+        events, _ = split_records(records)
+        fleet_trace_report = build_fleet_trace_report(trace, events)
+        report["fleet_trace"] = fleet_trace_report
     cap_report: Optional[Dict[str, Any]] = None
     if args.capacity:
         events, _ = split_records(records)
@@ -1527,6 +1877,8 @@ def main() -> int:
             print_report(report)
         if slo_report is not None and (args.slo or slo_report["problems"]):
             print_slo_report(slo_report)
+        if fleet_trace_report is not None:
+            print_fleet_trace_report(fleet_trace_report)
         if cap_report is not None:
             print_capacity_report(cap_report)
         if fleet_report is not None:
@@ -1546,6 +1898,11 @@ def main() -> int:
         return 1
     if args.strict and slo_report is not None and slo_report["problems"]:
         for p in slo_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
+        return 1
+    if (args.strict and fleet_trace_report is not None
+            and fleet_trace_report["problems"]):
+        for p in fleet_trace_report["problems"]:
             print(f"STRICT: {p}", file=sys.stderr)
         return 1
     if args.strict and cap_report is not None and cap_report["problems"]:

@@ -420,6 +420,7 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
             probe_count=pick(args.probe_count, fc.probe_count),
             probe_max_new=pick(args.probe_max_new, fc.probe_max_new),
             journal_path=journal_path,
+            journal_rotate_bytes=int(fc.journal_rotate_mb * 1024 * 1024),
             recover=args.recover,
         ).start()
 
@@ -491,6 +492,10 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
                 s["token"] = attach_token
             return s
 
+        # All RemoteReplicas share the tracer's recorder (or the process
+        # default): worker-exported spans land in the SAME buffer as the
+        # router's own, so one shutdown export yields the merged
+        # cross-host trace.
         replicas = [
             RemoteReplica(
                 i, _rep_spec(i), bus=bus,
@@ -498,6 +503,7 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
                 fault_injector=proc_faults,
                 backoff_seed=args.seed,
                 lease_s=lease_s,
+                recorder=tracer.recorder if tracer is not None else None,
             )
             for i in range(n_replicas)
         ]

@@ -178,6 +178,57 @@ def test_redrive_preserves_committed_frontier(params):
         assert len(tokens) == n_new
 
 
+def test_redrive_lineage_joins_one_trace_tree(params):
+    """With tracing on, a redriven request stays ONE lineage tree: a
+    single root span owned by the router, one ``req.attempt`` child per
+    placement attempt (the crashed attempt tagged ``redriven``, the
+    survivor ``done``), a single terminal, and terminal bodies carrying
+    replica + redrives alongside trace_id — checked with exactly the
+    tree logic the CI gate runs (obs_report)."""
+    from pretraining_llm_tpu.observability.spans import SpanRecorder
+    from pretraining_llm_tpu.observability.tracing import Tracer
+
+    prompts = _prompts(4)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new)
+    recorder = SpanRecorder(max_events=20000)
+    tracer = Tracer(recorder, sample=1.0, seed=0)
+    faults = ServingFaultInjector("replica_crash@req2:r0")
+    router = _fleet(params, faults=faults, tracer=tracer)
+    with router:
+        reqs = [router.submit(p, n_new) for p in prompts]
+        results = [r.result(timeout=120) for r in reqs]
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done"
+        assert tokens == ref[i]
+        # Satellite: terminal info names the serving replica and the
+        # redrive count next to the trace_id (what the gateway returns).
+        assert "trace_id" in info and "replica" in info
+        assert info["replica"] in (0, 1)
+        assert info["redrives"] >= 0
+    assert any(info["redrives"] > 0 for _, _, info in results)
+
+    trace = recorder.to_chrome_trace()
+    groups = obs_report.group_request_spans(trace)
+    assert len(groups) == len(prompts)
+    for tid, spans in groups.items():
+        assert obs_report.check_trace_tree(tid, spans) == []
+    report = obs_report.build_fleet_trace_report(trace)
+    assert report["problems"] == []
+    assert report["n_requests"] == len(prompts)
+    assert report["redriven_requests"] >= 1
+    redriven = next(
+        r for r in report["requests"] if (r["redrives"] or 0) > 0
+    )
+    outcomes = [a["outcome"] for a in redriven["attempts"]]
+    assert outcomes[-1] == "done" and "redriven" in outcomes[:-1]
+    # Attempt spans carry the redrive index they ran under — a
+    # monotone lineage, ending at the redrive count the client saw.
+    rd = [a["redrive"] for a in redriven["attempts"]]
+    assert rd == sorted(rd) and rd[0] == 0 and rd[-1] == redriven["redrives"]
+    assert abs(redriven["sum_error_s"]) <= 0.01 * redriven["e2e_s"] + 1e-9
+
+
 def test_survivor_allocator_matches_undisturbed(params):
     """After the drill settles, the survivor's allocator must hold
     exactly the blocks an undisturbed engine would (all freed), and the

@@ -54,12 +54,15 @@ from pretraining_llm_tpu.frontend.replica import Replica
 from pretraining_llm_tpu.frontend.router import Router
 from pretraining_llm_tpu.frontend.wire import (
     MAX_FRAME_BYTES,
+    PROTO_VERSION,
     ConnectionLost,
     ProtocolError,
     encode_frame,
     recv_frame,
     send_frame,
 )
+from pretraining_llm_tpu.observability.clocksync import ClockSync
+from pretraining_llm_tpu.observability.spans import SpanRecorder
 from pretraining_llm_tpu.generation.serving import ServingEngine
 from pretraining_llm_tpu.models import transformer
 from pretraining_llm_tpu.observability.events import EventBus
@@ -252,6 +255,170 @@ def test_wire_oversized_length_prefix_fails_fast():
         b.close()
 
 
+def test_wire_spans_frame_survives_dribble_and_tear():
+    """The v2 batched span-export frame is an ordinary length-prefixed
+    frame: sliced delivery reassembles exactly, and a peer dying mid-body
+    surfaces as the redrivable ConnectionLost, same as any other op."""
+    assert PROTO_VERSION >= 2  # spans frames are negotiable, not assumed
+    frame = {
+        "op": "spans", "g": 3, "dropped": 2,
+        "spans": [
+            {"name": "req.window", "t0": 1.5 + i, "dur": 0.25,
+             "meta": {"trace_id": "ab" * 16, "_track": "req ab"}}
+            for i in range(40)
+        ],
+    }
+    a, b = socket.socketpair()
+    try:
+        blob = encode_frame(frame)
+        cuts = [2, len(blob) // 3, len(blob) - 5]
+        pieces = [blob[i:j] for i, j in zip([0] + cuts, cuts + [len(blob)])]
+
+        def _dribble():
+            for piece in pieces:
+                a.sendall(piece)
+                time.sleep(0.01)
+
+        t = threading.Thread(target=_dribble, daemon=True)
+        t.start()
+        assert recv_frame(b) == frame
+        t.join(timeout=5)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    a.sendall(blob[: len(blob) // 2])
+    a.close()
+    with pytest.raises(ConnectionLost):
+        recv_frame(b)
+    b.close()
+
+
+# -- clock-offset estimator (no JAX, injected clocks) ------------------------
+
+
+def _simulate_round_trips(sync, offset_s, rtts, stamp_fracs, t0=100.0):
+    """Drive the estimator with a synthetic remote peer whose clock reads
+    ``local - offset_s``: round trip i takes ``rtts[i]`` seconds and the
+    peer stamps its reply at fraction ``stamp_fracs[i]`` of the trip (the
+    midpoint assumption is exact at 0.5; anything else is estimator
+    error the RTT/2 bound must still cover)."""
+    t = t0
+    for rtt, frac in zip(rtts, stamp_fracs):
+        t_send = t
+        t_recv = t + rtt
+        t_remote = (t_send + frac * rtt) - offset_s
+        sync.observe(t_send, t_recv, t_remote)
+        t += rtt + 0.05
+
+
+@pytest.mark.parametrize(
+    "offset_s", [-5137.25, -0.5, 0.0, 0.25, 86400.0],
+    ids=["far_behind", "behind", "aligned", "ahead", "far_ahead"],
+)
+def test_clocksync_skewed_jittery_grid(offset_s):
+    """Whatever the epoch skew, the estimate lands within the advertised
+    error bound, and the bound is half the best RTT seen — jittery
+    (congested) round trips widen individual samples but the min-RTT
+    filter keeps the headline estimate at the tightest one."""
+    rng = np.random.default_rng(7)
+    rtts = (0.002 + rng.random(24) * 0.040).tolist()  # 2..42 ms, jittery
+    fracs = rng.random(24).tolist()  # stamp anywhere inside the trip
+    sync = ClockSync(window=16)
+    _simulate_round_trips(sync, offset_s, rtts, fracs)
+    assert sync.n_samples == 24
+    est, bound = sync.offset_s, sync.error_bound_s
+    assert est is not None and bound is not None
+    assert abs(est - offset_s) <= bound + 1e-9
+    # The bound is half the best RTT inside the sliding window.
+    assert bound == pytest.approx(min(rtts[-16:]) / 2.0)
+    # to_local maps a remote stamp back to within the bound.
+    t_remote = 500.0
+    assert abs(sync.to_local(t_remote) - (t_remote + offset_s)) <= bound + 1e-9
+
+
+def test_clocksync_tracks_drift_newest_wins_ties():
+    """Equal-RTT samples tie toward the NEWEST: a drifting remote clock
+    (perf_counter rates differ across hosts) keeps being re-estimated at
+    every heartbeat instead of pinning the first lucky sample."""
+    sync = ClockSync(window=8)
+    for i in range(8):
+        drift_offset = 10.0 + i * 0.001
+        _simulate_round_trips(
+            sync, drift_offset, [0.004], [0.5], t0=100.0 + i
+        )
+    assert sync.offset_s == pytest.approx(10.0 + 7 * 0.001)
+
+
+def test_clocksync_window_evicts_stale_tight_sample():
+    """One early lucky tight sample must not pin the estimate forever:
+    once it slides out of the window, the estimate comes from the
+    samples that remain."""
+    sync = ClockSync(window=4)
+    _simulate_round_trips(sync, 1.0, [0.001], [0.5])  # lucky + tight
+    for _ in range(4):  # fills the window, evicting the tight sample
+        _simulate_round_trips(sync, 2.0, [0.010], [0.5])
+    assert sync.offset_s == pytest.approx(2.0)
+    assert sync.error_bound_s == pytest.approx(0.005)
+
+
+def test_clocksync_reset_and_bad_samples():
+    with pytest.raises(ValueError, match="window"):
+        ClockSync(window=0)
+    sync = ClockSync()
+    assert sync.offset_s is None and sync.error_bound_s is None
+    assert sync.to_local(1.0) is None
+    sync.observe(2.0, 1.0, 50.0)  # negative RTT: discarded
+    assert sync.offset_s is None
+    sync.observe(1.0, 1.01, 50.0)
+    assert sync.offset_s is not None
+    sync.reset()  # new connection generation: unrelated epoch
+    assert sync.offset_s is None
+    snap = sync.snapshot()
+    # Only the accepted sample ever counted; reset keeps the tally.
+    assert snap["offset_s"] is None and snap["n_samples"] == 1
+
+
+# -- span ingestion: clock mapping at the router edge (no JAX) ---------------
+
+
+def test_remote_span_ingest_aligns_or_flags():
+    """RemoteReplica._ingest_spans maps worker-epoch timestamps through
+    the live offset estimate (recording the error bound on each span) and
+    flags spans that arrive before any estimate exists as ``unaligned``
+    instead of plotting them at a meaningless time."""
+    rec = SpanRecorder(max_events=64)
+    rep = RemoteReplica(0, _worker_spec(), recorder=rec)
+    tid = "ab" * 16
+    # Before any clock sample: kept but flagged.
+    rep._ingest_spans({
+        "spans": [{"name": "req.window", "t0": 100.0, "dur": 0.1,
+                   "meta": {"trace_id": tid, "_track": "req " + tid[:12]}}],
+        "dropped": 3,
+    })
+    # After a tight round trip: mapped into the local timeline.
+    rep.clock_sync.observe(10.0, 10.01, 100.0)  # offset ~= -89.995
+    rep._ingest_spans({
+        "spans": [{"name": "req.prefill", "t0": 100.5, "dur": 0.2,
+                   "meta": {"trace_id": tid}}],
+        "dropped": 0,
+    })
+    assert rep._c_spans.value == 2
+    assert rep._c_span_drops.value == 3
+    events, _ = rec.drain()
+    by_name = {name: (t0, meta) for name, t0, _d, _t, _dep, meta in events}
+    t0_un, meta_un = by_name["req.window"]
+    assert meta_un["unaligned"] is True and meta_un["remote"] is True
+    assert meta_un["worker"] == 0
+    t0_al, meta_al = by_name["req.prefill"]
+    assert t0_al == pytest.approx(100.5 - 89.995)
+    assert meta_al["clock_err_s"] == pytest.approx(0.005)
+    assert "unaligned" not in meta_al
+    # Malformed entries are skipped, never crash the reader thread.
+    rep._ingest_spans({"spans": [{"name": "x"}, "junk", None], "dropped": 0})
+    assert rep._c_spans.value == 2
+
+
 # -- fleet journal (no JAX, no socket) --------------------------------------
 
 
@@ -311,6 +478,205 @@ def test_journal_recovery_plan():
 def test_router_recover_requires_journal_path():
     with pytest.raises(ValueError, match="journal_path"):
         Router([Replica(0, lambda: None)], recover=True)
+
+
+# -- journal compaction (no JAX, no socket) ----------------------------------
+
+
+def test_journal_rotation_compacts_to_recovery_plan(tmp_path):
+    """Size-threshold rotation rewrites the journal down to its recovery
+    fold: max fences, live submits at their frontiers (trace_id intact),
+    and the frid high-water mark — and a router recovering from the
+    rotated file sees EXACTLY the plan the unrotated one implied."""
+    path = str(tmp_path / "fleet.jsonl")
+    with pytest.raises(ValueError, match="rotate_bytes"):
+        FleetJournal(path, rotate_bytes=-1)
+    j = FleetJournal(path, rotate_bytes=4096)
+    j.append({"rec": "member", "replica": 0, "mode": "attach"})
+    j.append({"rec": "fence", "replica": 0, "fence": 2})
+    j.append({"rec": "fence", "replica": 1, "fence": 5})
+    filler = list(range(64))  # bulk per record so the threshold trips
+    for frid in range(24):
+        j.append({
+            "rec": "submit", "frid": frid, "prompt": filler,
+            "max_new": 4, "priority": frid % 3, "deadline_s": None,
+            "trace_id": f"{frid:032x}",
+        })
+        if frid != 21:  # one live straggler with a frontier
+            j.append({"rec": "terminal", "frid": frid, "status": "done"})
+    j.append({"rec": "frontier", "frid": 21, "tokens": [9, 8], "redrives": 1})
+    assert j.rotations >= 1
+    assert os.path.getsize(path) < 4096
+    assert not os.path.exists(path + ".rotate")  # temp swapped in, not left
+
+    plan = FleetJournal.recovery_plan(FleetJournal.load(path))
+    assert plan["fences"] == {0: 2, 1: 5}
+    assert plan["next_frid"] == 24  # high-water mark survives compaction
+    assert sorted(plan["live"]) == [21]
+    assert plan["live"][21]["tokens"] == [9, 8]
+    assert plan["live"][21]["redrives"] == 1
+    assert plan["live"][21]["trace_id"] == f"{21:032x}"
+    assert plan["live"][21]["prompt"] == filler
+
+    # The journal keeps appending seamlessly after the swap.
+    j.append({"rec": "terminal", "frid": 21, "status": "done"})
+    j.close()
+    plan2 = FleetJournal.recovery_plan(FleetJournal.load(path))
+    assert plan2["live"] == {}
+    assert plan2["next_frid"] == 24
+
+
+def test_journal_rotation_crash_torn_mid_rotate(tmp_path):
+    """Crashes around rotation never lose the journal: a failure while
+    WRITING the temp aborts the rotation and keeps the original complete
+    file; a stale torn ``.rotate`` temp from a crashed predecessor is
+    ignored by load and overwritten by the next successful rotation."""
+    path = str(tmp_path / "fleet.jsonl")
+    j = FleetJournal(path, rotate_bytes=256)
+    j.append({"rec": "fence", "replica": 0, "fence": 1})
+
+    # Crash mid-temp-write: fsync blows up inside _rotate_locked's try.
+    real_fsync = os.fsync
+
+    def _boom(fd):
+        raise OSError("disk full")
+
+    os.fsync = _boom
+    try:
+        j.append({
+            "rec": "submit", "frid": 0, "prompt": list(range(80)),
+            "max_new": 4, "priority": 0, "deadline_s": None,
+            "trace_id": "a" * 32,
+        })
+    finally:
+        os.fsync = real_fsync
+    assert j.rotations == 0
+    assert not os.path.exists(path + ".rotate")  # aborted temp unlinked
+    plan = FleetJournal.recovery_plan(FleetJournal.load(path))
+    assert plan["fences"] == {0: 1} and sorted(plan["live"]) == [0]
+
+    # A torn temp left by a crashed predecessor (died between writing
+    # some of the temp and the atomic replace) must not confuse anyone.
+    with open(path + ".rotate", "w", encoding="utf-8") as f:
+        f.write('{"rec": "fence", "replica": 9, "fen')  # torn mid-line
+    j.append({"rec": "frontier", "frid": 0, "tokens": [3], "redrives": 0})
+    assert j.rotations >= 1  # this append trips a SUCCESSFUL rotation
+    assert not os.path.exists(path + ".rotate")
+    j.close()
+    plan = FleetJournal.recovery_plan(FleetJournal.load(path))
+    assert plan["fences"] == {0: 1}  # the torn temp's replica 9 is nowhere
+    assert plan["live"][0]["tokens"] == [3]
+
+
+# -- cross-host lineage trees: obs_report over synthetic traces (no JAX) -----
+
+
+def _tev(name, ts_us, dur_us, trace_id, span_id, parent=None, **args):
+    a = {"trace_id": trace_id, "span_id": span_id, **args}
+    if parent is not None:
+        a["parent_span_id"] = parent
+    return {"ph": "X", "name": name, "ts": ts_us, "dur": dur_us,
+            "pid": 1, "tid": 1, "args": a}
+
+
+def _lineage_trace(clock_err_s=0.001, worker_shift_us=0.0, unaligned=False):
+    """One redriven request as the merged export sees it: router root,
+    two attempts (first redriven, second served by a remote worker), and
+    the worker's clock-aligned subtree nested under attempt 2."""
+    tid = "f" * 32
+    evs = [
+        _tev("req.request", 1_000_000, 200_000, tid, "r0", status="done",
+             redrives=1),
+        _tev("req.attempt", 1_010_000, 50_000, tid, "a1", parent="r0",
+             outcome="redriven", replica=0, fence=1, redrive=0),
+        _tev("req.attempt", 1_090_000, 100_000, tid, "a2", parent="r0",
+             outcome="done", replica=1, fence=2, redrive=1),
+        _tev("req.terminal", 1_199_000, 0, tid, "t0", parent="r0",
+             status="done"),
+    ]
+    wargs = {"remote": True, "worker": 1}
+    if unaligned:
+        wargs["unaligned"] = True
+    else:
+        wargs["clock_err_s"] = clock_err_s
+    s = worker_shift_us
+    evs += [
+        _tev("req.request", 1_095_000 + s, 90_000, tid, "w0", parent="a2",
+             status="done", **wargs),
+        _tev("req.queue", 1_096_000 + s, 2_000, tid, "w1", parent="w0",
+             **wargs),
+        _tev("req.prefill", 1_098_000 + s, 10_000, tid, "w2", parent="w0",
+             **wargs),
+        _tev("req.window", 1_110_000 + s, 60_000, tid, "w3", parent="w0",
+             **wargs),
+        _tev("req.first_token", 1_112_000 + s, 0, tid, "w4", parent="w0",
+             **wargs),
+        _tev("req.terminal", 1_184_000 + s, 0, tid, "w5", parent="w0",
+             status="done", **wargs),
+    ]
+    return {"traceEvents": evs, "otherData": {}}
+
+
+def test_check_trace_tree_accepts_worker_subtrees():
+    trace = _lineage_trace()
+    groups = obs_report.group_request_spans(trace)
+    (tid, spans), = groups.items()
+    assert obs_report.check_trace_tree(tid, spans) == []
+    # The same subtree orphaned from its attempt is a structural problem.
+    bad = _lineage_trace()
+    for ev in bad["traceEvents"]:
+        if ev["args"].get("span_id") == "w0":
+            ev["args"]["parent_span_id"] = "nonexistent"
+    (tid, spans), = obs_report.group_request_spans(bad).items()
+    assert any("not parented to any req.attempt" in p
+               for p in obs_report.check_trace_tree(tid, spans))
+
+
+def test_fleet_trace_report_decomposes_across_attempts():
+    report = obs_report.build_fleet_trace_report(_lineage_trace())
+    assert report["problems"] == []
+    assert report["n_requests"] == 1
+    assert report["redriven_requests"] == 1
+    assert report["n_worker_spans"] == 6
+    assert report["n_unaligned"] == 0
+    (req,) = report["requests"]
+    seg = req["segments"]
+    # placement (10ms) + attempts (150ms) + gap (30ms) + finish (10ms)
+    assert seg["placement_s"] == pytest.approx(0.010)
+    assert seg["attempts_s"] == pytest.approx(0.150)
+    assert seg["redrive_gap_s"] == pytest.approx(0.030)
+    assert seg["finish_s"] == pytest.approx(0.010)
+    assert abs(req["sum_error_s"]) < 1e-9  # sums to e2e by construction
+    a1, a2 = req["attempts"]
+    assert (a1["outcome"], a1["replica"], a1["redrive"]) == ("redriven", 0, 0)
+    assert (a2["outcome"], a2["replica"], a2["redrive"]) == ("done", 1, 1)
+    assert a2["worker_spans"] == 6
+    assert a2["worker_decode_s"] == pytest.approx(0.060)
+    assert a2["clock_err_s"] == pytest.approx(0.001)
+    # The gap joins to the redrive event that explains it.
+    events = [{"event": "redrive", "trace_id": "f" * 32, "reason": "crash",
+               "t_wall": 1.07}]
+    report = obs_report.build_fleet_trace_report(_lineage_trace(), events)
+    (req,) = report["requests"]
+    assert req["gaps"][0]["causes"] == ["redrive:crash"]
+
+
+def test_fleet_trace_report_strict_problems():
+    # Unalignable spans (no offset estimate at ingest) are strict.
+    report = obs_report.build_fleet_trace_report(_lineage_trace(unaligned=True))
+    assert report["n_unaligned"] == 6
+    assert any("unalignable" in p for p in report["problems"])
+    # A worker span outside its attempt window beyond the recorded clock
+    # error bound means the alignment claim is false — also strict.
+    report = obs_report.build_fleet_trace_report(
+        _lineage_trace(clock_err_s=0.001, worker_shift_us=20_000)
+    )
+    assert any("outside its attempt window" in p for p in report["problems"])
+    # Within the bound (+ slack): fine.
+    report = obs_report.build_fleet_trace_report(
+        _lineage_trace(clock_err_s=0.025, worker_shift_us=20_000)
+    )
+    assert not any("outside" in p for p in report["problems"])
 
 
 # -- fault grammar + actions + config ---------------------------------------
