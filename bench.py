@@ -263,7 +263,7 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--fleet-scenario", default="kill",
         choices=[
             "kill", "rolling", "hotprefix", "upgrade", "proc-kill",
-            "partition",
+            "partition", "disagg",
         ],
         help="serving-fleet mode: kill = deterministic replica_crash on "
         "replica 0 one third into the burst (redrive drill); rolling = "
@@ -278,7 +278,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "(reads hang, writes buffer — no RST), lease expiry redrives its "
         "work, heal after redrive and count the stale-generation frames "
         "the fence filter drops (zero lost + zero duplicated invariants "
-        "recorded)",
+        "recorded); disagg = disaggregated tiers — replica 0 serves only "
+        "prefill legs, the rest only decode, zipf-skewed shared-prefix "
+        "traffic migrates KV pages prefill->decode and the record is the "
+        "decode tier's TTFT while the prefill tier absorbs the prefill "
+        "burst (kv migration counters recorded)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -904,7 +908,7 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     n_requests = args.n_requests or 4 * max_batch * args.replicas
     pfx_pool = args.prefix_pool_size
     pfx_len = 0
-    if args.fleet_scenario == "hotprefix":
+    if args.fleet_scenario in ("hotprefix", "disagg"):
         pfx_pool = pfx_pool or 2 * args.replicas
         block_size = min(block_size, max(8, cfg.context_length // 8))
         pfx_len = args.prefix_len or 2 * block_size
@@ -920,13 +924,19 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     sps = args.steps_per_sched or 8
     depth = args.pipeline_depth or 2
 
+    # The disagg scenario is meaningless without a prefix cache (there
+    # would be nothing to snapshot) and enables kv_checksum so migrated
+    # pages carry + verify their integrity identity, as in production.
+    disagg = args.fleet_scenario == "disagg"
+
     def make_engine():
         return ServingEngine(
             params, cfg, max_batch=max_batch, n_blocks=n_blocks,
             block_size=block_size, temperature=0.0,
             steps_per_sched=sps, pipeline_depth=depth,
             admit_batch=args.admit_batch,
-            prefix_cache=args.prefix_cache,
+            prefix_cache=args.prefix_cache or disagg,
+            kv_checksum=disagg,
         )
 
     faults = None
@@ -984,6 +994,12 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         replicas = [
             Replica(
                 i, make_engine, fault_injector=faults,
+                # disagg: replica 0 is the dedicated prefill tier (no
+                # client traffic), everyone else decodes migrated pages.
+                role=(
+                    ("prefill" if i == 0 else "decode") if disagg
+                    else "both"
+                ),
                 admission_factory=lambda reg: AdmissionController(
                     max_queue_depth=4 * max_batch, registry=reg
                 ),
@@ -1126,10 +1142,21 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         "wall_s": round(report.wall_s, 2),
         "device": jax.devices()[0].device_kind,
     }
-    if args.fleet_scenario == "hotprefix":
+    if args.fleet_scenario in ("hotprefix", "disagg"):
         rec["prefix_pool_size"] = pfx_pool
         rec["prefix_len"] = pfx_len
         rec["prefix_zipf"] = args.prefix_zipf
+    if args.fleet_scenario == "disagg":
+        # Decode-tier latency under prefill-tier load: every client
+        # request is served by a decode replica (the prefill tier takes
+        # only migration legs), so the TTFT percentiles above ARE the
+        # decode tier's.
+        rec["prefill_replicas"] = 1
+        rec["kv_migrations"] = counters.get("kv_migrations", 0)
+        rec["kv_pages_migrated"] = counters.get("kv_pages_migrated", 0)
+        rec["kv_migration_rejects"] = counters.get(
+            "kv_migration_rejects", 0
+        )
     if args.fleet_scenario == "partition":
         # Partition-heal invariants: nothing lost (every scheduled
         # request got a terminal), nothing duplicated (no done request

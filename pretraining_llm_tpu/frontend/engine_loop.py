@@ -30,7 +30,7 @@ import queue
 import threading
 import time
 import warnings
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from pretraining_llm_tpu.frontend.admission import (
     AdmissionController,
@@ -261,6 +261,15 @@ class EngineLoop:
         # keeps turning).
         self._last_turn = self._clock()
         self._inbox: "queue.Queue[FrontendRequest]" = queue.Queue()
+        # Control mailbox: callables executed ON the loop thread between
+        # scheduler turns. This is the only sanctioned way for another
+        # thread to mutate engine device state (e.g. KV-page adoption
+        # writes ``engine.pools`` — racing the loop thread's own pools
+        # swap would lose one side's update). Reads of committed state
+        # don't need it; writes do.
+        self._control: "queue.Queue[Tuple[Callable[[], Any], queue.Queue]]" = (
+            queue.Queue()
+        )
         # Guards the submit-side put against the shutdown drain: once the
         # loop thread has drained the inbox (_drained), a late put would
         # enqueue a request nothing will ever terminate.
@@ -529,6 +538,31 @@ class EngineLoop:
             )
             _finish_trace(trace, "rejected", reason=reason)
 
+    def run_on_loop(
+        self, fn: Callable[[], Any], *, timeout: Optional[float] = 30.0
+    ) -> Any:
+        """Run ``fn()`` on the loop thread between scheduler turns and
+        return its result (re-raising its exception). The engine owns all
+        device dispatch on that one thread, so any caller that must WRITE
+        engine state (KV-page adoption swaps ``engine.pools``) funnels
+        through here instead of racing the turn loop. Draining loops
+        still execute control work — adoption into a draining replica is
+        legal; only a stopped/dead loop refuses."""
+        if self._stop.is_set() or self._thread is None or not self._thread.is_alive():
+            raise RuntimeError("EngineLoop is not running")
+        done: "queue.Queue[Tuple[str, Any]]" = queue.Queue(maxsize=1)
+        self._control.put((fn, done))
+        self._wake.set()
+        try:
+            kind, value = done.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"loop-thread control call did not complete in {timeout}s"
+            )
+        if kind == "err":
+            raise value
+        return value
+
     def cancel(self, req: FrontendRequest) -> None:
         """Request cancellation (client disconnect / explicit abort). The
         loop applies it between scheduler turns; tokens already committed
@@ -683,6 +717,7 @@ class EngineLoop:
         try:
             while True:
                 self._wake.clear()
+                self._drain_control()
                 self._drain_inbox()
                 self._apply_cancels_and_deadlines()
                 if self._stop.is_set():
@@ -751,6 +786,37 @@ class EngineLoop:
                 except queue.Empty:
                     break
                 self._terminal(req, "error", reason=reason)
+            # Control callers blocked in run_on_loop must not hang until
+            # their timeout: the loop is down, tell them now.
+            while True:
+                try:
+                    _, done = self._control.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    done.put_nowait(
+                        ("err", RuntimeError(f"EngineLoop stopped: {reason}"))
+                    )
+                except queue.Full:
+                    pass
+
+    def _drain_control(self) -> None:
+        """Execute queued control callables (loop thread). A callable's
+        exception is delivered to its caller, never allowed to kill the
+        loop — control work is auxiliary to serving."""
+        while True:
+            try:
+                fn, done = self._control.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                result = ("ok", fn())
+            except BaseException as e:  # delivered, not raised here
+                result = ("err", e)
+            try:
+                done.put_nowait(result)
+            except queue.Full:
+                pass  # caller timed out and went away
 
     def _drain_inbox(self) -> None:
         eng = self.engine

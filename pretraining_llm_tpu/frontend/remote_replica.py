@@ -82,6 +82,7 @@ import numpy as np
 from ..observability import spans as _spans
 from ..observability.clocksync import ClockSync
 from ..observability.metrics import MetricsRegistry
+from . import kv_transfer
 from .admission import RejectedBusy
 from .engine_loop import _TRACE_UNSET, FrontendRequest
 from .replica import REPLICA_STATES, ReplicaUnavailable
@@ -447,6 +448,10 @@ class RemoteReplica:
         self._python = python
         self.attach = str(self.spec.get("attach") or "")
         self.mode = "attach" if self.attach else "process"
+        # Disaggregation role. The spec is the request; the hello reply
+        # is the truth (an attach-mode worker was launched with its own
+        # --role and may disagree with a stale router config).
+        self.role = str(self.spec.get("role") or "both")
 
         self.registry = MetricsRegistry(
             registry_prefix,
@@ -509,6 +514,12 @@ class RemoteReplica:
         self._pending_lock = threading.Lock()
         self._attempts: Dict[int, FrontendRequest] = {}
         self._attempts_lock = threading.Lock()
+        # KV-fetch collectors: fetch rid -> list of kv_page frames. The
+        # reader thread is single-threaded and the worker streams every
+        # page frame BEFORE the summary reply, so when the fetch RPC
+        # returns the collector is complete by construction.
+        self._kv_rx: Dict[int, List[Dict[str, Any]]] = {}
+        self._kv_rx_lock = threading.Lock()
         self._snapshot: Dict[str, Any] = {"running": False}
         self._rng = random.Random(backoff_seed * 1000003 + self.index)
         self._rng_lock = threading.Lock()
@@ -708,6 +719,7 @@ class RemoteReplica:
                 f"fingerprint {got!r}, expected {expect!r}"
             )
         self._peer_proto = int(hello.get("proto", 1))
+        self.role = str(hello.get("role") or self.role)
         self.engine = _RemoteEngine(self, hello)
         if self.loop is None:
             self.loop = _RemoteLoop(self)
@@ -884,6 +896,22 @@ class RemoteReplica:
                 self._finish_trace(attempt)
                 attempt.out_q.put(
                     ("end", attempt.status, dict(attempt.info))
+                )
+            return
+        if frame.get("op") == "kv_page":
+            # One frame of a KV fetch stream, keyed by the fetch RPC's
+            # id. Unknown keys mean the fetch already gave up (timeout)
+            # or this is a stale-connection straggler: drop silently —
+            # pages are a cache warm-up, never correctness.
+            with self._kv_rx_lock:
+                lst = self._kv_rx.get(frame.get("fetch"))
+            if lst is not None:
+                lst.append(
+                    {
+                        k: v
+                        for k, v in frame.items()
+                        if k not in ("op", "fetch", "g")
+                    }
                 )
             return
         if frame.get("op") == "spans":
@@ -1125,6 +1153,126 @@ class RemoteReplica:
     def load(self) -> int:
         return len(self._attempts)
 
+    # -- KV-page migration (frontend/kv_transfer.py) ------------------
+
+    @property
+    def kv_capable(self) -> bool:
+        """Whether this worker can take part in a KV migration: alive
+        and speaking proto >= 3 (the kv_fetch/kv_page ops). A capable
+        worker without a prefix cache simply answers every fetch with
+        zero pages and rejects every push — graceful, not special."""
+        return self.alive and self._peer_proto >= 3
+
+    def fetch_kv_pages(
+        self,
+        prompt: Any,
+        *,
+        max_pages: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Pull the longest cached KV chain for ``prompt`` from this
+        worker as a transfer dict, or None. Best-effort by contract:
+        every failure mode (not capable, timeout, torn stream, nothing
+        cached) returns None — the router falls back to a colocated
+        prefill, never an error. Single attempt, no retries: a fetch is
+        an optimization racing a request that could just run."""
+        if not self.kv_capable:
+            return None
+        timeout = self.rpc_timeout_s if timeout is None else float(timeout)
+        with self._conn_lock:
+            sock, gen = self._sock, self._conn_gen
+        if sock is None:
+            return None
+        # The collector must exist before the request hits the wire:
+        # the worker streams page frames ahead of the summary reply.
+        with self._pending_lock:
+            self._rpc_seq += 1
+            rid = self._rpc_seq
+            q: "queue.Queue" = queue.Queue()
+            self._pending[rid] = q
+        frames: List[Dict[str, Any]] = []
+        with self._kv_rx_lock:
+            self._kv_rx[rid] = frames
+        payload: Dict[str, Any] = {
+            "op": "kv_fetch",
+            "id": rid,
+            "prompt": [int(t) for t in prompt],
+        }
+        if max_pages is not None:
+            payload["max_pages"] = int(max_pages)
+        t0 = time.monotonic()
+        try:
+            try:
+                with self._wlock:
+                    send_frame(sock, payload)
+                reply = q.get(timeout=timeout)
+            except ConnectionLost as e:
+                self._on_conn_lost(gen, f"send failed during kv_fetch: {e}")
+                return None
+            except queue.Empty:
+                return None
+        finally:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            with self._kv_rx_lock:
+                self._kv_rx.pop(rid, None)
+        self._h_rpc.observe(time.monotonic() - t0)
+        self._last_ok = time.monotonic()
+        ok = reply.get("ok")
+        if not isinstance(ok, dict) or int(ok.get("pages", 0) or 0) < 1:
+            return None
+        try:
+            return kv_transfer.join_frames(frames)
+        except ValueError:
+            return None  # torn mid-stream (reconnect raced the fetch)
+
+    def push_kv_pages(
+        self, xfer: Dict[str, Any], *, timeout: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Stream a transfer dict to this worker and adopt it behind
+        its prefix-cache publish path. Returns the worker's adoption
+        summary (``inserted``/``rejected``/``published``/``reason``) or
+        None if the push could not run. Interior frames ride without an
+        id; the final frame is a normal RPC so the adoption verdict
+        comes back on the pending queue."""
+        if not self.kv_capable:
+            return None
+        take = (
+            getattr(self.faults, "take_kv_corruption", None)
+            if self.faults is not None
+            else None
+        )
+        if take is not None and take(self.index):
+            kv_transfer.corrupt_first_page(xfer)
+            self._emit(
+                "fault_fired", fault="corrupt_kv_migration", replica=self.index
+            )
+        frames = kv_transfer.split_frames(xfer)
+        with self._pending_lock:
+            self._rpc_seq += 1
+            xid = f"kvpush-{self._rpc_seq}"
+        with self._conn_lock:
+            sock, gen = self._sock, self._conn_gen
+        if sock is None:
+            return None
+        try:
+            for fr in frames[:-1]:
+                with self._wlock:
+                    send_frame(sock, {"op": "kv_page", "xfer": xid, **fr})
+        except ConnectionLost as e:
+            self._on_conn_lost(gen, f"send failed during kv_page push: {e}")
+            return None
+        try:
+            res = self._rpc(
+                "kv_page",
+                {"xfer": xid, **frames[-1]},
+                timeout=timeout,
+                retries=0,
+            )
+        except Exception:
+            return None
+        return dict(res) if isinstance(res, dict) else None
+
     def submit(
         self,
         prompt: Any,
@@ -1341,6 +1489,7 @@ class RemoteReplica:
             "submits": self.submits,
             "alive": self.alive,
             "mode": self.mode,
+            "role": self.role,
             "fence": self.fence,
             "pid": self._proc.pid if self._proc is not None else None,
         }

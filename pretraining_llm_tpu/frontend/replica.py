@@ -45,9 +45,18 @@ from pretraining_llm_tpu.frontend.engine_loop import (
     EngineLoop,
     FrontendRequest,
 )
+from pretraining_llm_tpu.frontend import kv_transfer
 from pretraining_llm_tpu.observability.metrics import MetricsRegistry
 
 REPLICA_STATES = ("active", "draining", "ejected")
+
+# Disaggregation roles: what traffic the router may place here.
+#   both     the classic colocated replica (prefill + decode);
+#   decode   serves client requests, receives migrated KV pages;
+#   prefill  dedicated prefill tier — computes prompts (max_new=1 legs
+#            via the direct loop lane) and ships the published pages;
+#            the router never routes client decode traffic to it.
+REPLICA_ROLES = ("prefill", "decode", "both")
 
 # Gauge encoding for the typed ``replica_state`` metric: chosen so "is it
 # taking traffic" is a simple ``== 1`` and alerting thresholds are stable.
@@ -104,7 +113,13 @@ class Replica:
         fault_injector: Any = None,
         clock: Any = time.monotonic,
         loop_kwargs: Optional[Dict[str, Any]] = None,
+        role: str = "both",
     ) -> None:
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        self.role = role
         self.index = int(index)
         self._engine_factory = engine_factory
         self._bus = bus
@@ -314,12 +329,74 @@ class Replica:
             self.faults.on_submit(self.index, nth)
         return req
 
+    # -- KV-page migration (frontend/kv_transfer.py) ------------------------
+
+    @property
+    def kv_capable(self) -> bool:
+        """Whether this replica can send/receive migrated KV pages: it
+        needs a live engine with a prefix cache (the publish path the
+        pages enter and leave through)."""
+        eng = self.engine
+        return (
+            self.alive
+            and eng is not None
+            and getattr(eng, "prefix_cache", None) is not None
+        )
+
+    def fetch_kv_pages(
+        self,
+        prompt: Any,
+        *,
+        max_pages: Optional[int] = None,
+        timeout: float = 30.0,
+    ) -> Optional[Dict[str, Any]]:
+        """Serialize the longest cached chain covering ``prompt`` from
+        this replica's pool; None when nothing is cached. Round-trips
+        through the frame codec even in-process so both fleet modes
+        exercise the one serialization path the wire uses."""
+        eng = self.engine
+        if eng is None or not self.alive:
+            return None
+        xfer = kv_transfer.snapshot_chain(eng, prompt, max_pages=max_pages)
+        if xfer is None:
+            return None
+        return kv_transfer.join_frames(kv_transfer.split_frames(xfer))
+
+    def push_kv_pages(
+        self, xfer: Dict[str, Any], *, timeout: float = 30.0
+    ) -> Optional[Dict[str, Any]]:
+        """Adopt migrated pages into this replica's pool (loop-thread
+        insertion via run_on_loop). Returns adopt_chain's summary, or
+        None when the replica cannot take pages right now. An armed
+        ``corrupt_kv_migration`` fault flips bytes in the transfer
+        in flight, exactly like the wire-level drill."""
+        loop = self.loop
+        if loop is None or not self.alive:
+            return None
+        take = getattr(self.faults, "take_kv_corruption", None)
+        if take is not None and take(self.index):
+            kv_transfer.corrupt_first_page(xfer)
+            if self._bus is not None:
+                self._bus.emit(
+                    "fault_fired",
+                    fault="corrupt_kv_migration",
+                    replica=self.index,
+                )
+        eng = self.engine
+        try:
+            return loop.run_on_loop(
+                lambda: kv_transfer.adopt_chain(eng, xfer), timeout=timeout
+            )
+        except (RuntimeError, TimeoutError):
+            return None
+
     # -- introspection ------------------------------------------------------
 
     def debug_snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "replica": self.index,
             "state": self.state,
+            "role": self.role,
             "generation": self.generation,
             "submits": self.submits,
             "alive": self.alive,

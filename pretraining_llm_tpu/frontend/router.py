@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
+from pretraining_llm_tpu.frontend import kv_transfer
 from pretraining_llm_tpu.frontend.admission import (
     AdmissionController,
     RejectedBusy,
@@ -205,6 +206,8 @@ class Router:
         journal_path: str = "",
         journal_rotate_bytes: int = 0,
         recover: bool = False,
+        kv_migrate_timeout_s: float = 30.0,
+        kv_home_max: int = 4096,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -285,6 +288,23 @@ class Router:
         self._last_probe_t: Dict[int, float] = {}
         self._probe_idx = 0
         self._next_probe_at = 0.0
+        if kv_migrate_timeout_s <= 0:
+            raise ValueError(
+                f"kv_migrate_timeout_s must be > 0, got {kv_migrate_timeout_s}"
+            )
+        if kv_home_max < 1:
+            raise ValueError(f"kv_home_max must be >= 1, got {kv_home_max}")
+        self.kv_migrate_timeout_s = float(kv_migrate_timeout_s)
+        self.kv_home_max = int(kv_home_max)
+        # KV placement map: prefix digest -> replica index that most
+        # recently ADOPTED migrated pages for that prefix. Generalizes
+        # prefix-affinity: rendezvous hashing predicts where a prefix
+        # SHOULD live; this records where its pages actually ARE, so
+        # follow-up requests land on the warmed decode worker. Insertion
+        # ordered, capped at kv_home_max (oldest entry evicted) — a
+        # stale entry only costs a cold prefill, never correctness.
+        self._kv_home: Dict[bytes, int] = {}
+        self._kv_home_lock = threading.Lock()
         self.decisions = DecisionLog(maxlen=256, bus=bus)
         self._live: Dict[int, RouterRequest] = {}
         self._live_lock = threading.Lock()
@@ -304,6 +324,8 @@ class Router:
             "probes": 0, "probe_failures": 0, "quarantines": 0,
             "relaunches": 0, "upgrades": 0, "upgrades_refused": 0,
             "journal_replays": 0,
+            "kv_migrations": 0, "kv_pages_migrated": 0,
+            "kv_migration_rejects": 0,
         }
         self._g_state: Dict[int, Any] = {}
         self._g_backoff: Dict[int, Any] = {}
@@ -312,6 +334,7 @@ class Router:
         self._c_relaunches = None
         self._c_replays = None
         self._g_brownout = None
+        self._c_kv_pages = self._c_kv_bytes = self._c_kv_rejects = None
         if registry is not None:
             for rep in self.replicas:
                 self._g_state[rep.index] = registry.gauge(
@@ -353,6 +376,17 @@ class Router:
                 "router_journal_replays_total",
                 "journaled in-flight requests redriven by a recovering "
                 "router")
+            self._c_kv_pages = registry.counter(
+                "kv_pages_migrated_total",
+                "KV pages adopted by decode workers from prefill-tier "
+                "migrations")
+            self._c_kv_bytes = registry.counter(
+                "kv_migrated_bytes_total",
+                "serialized bytes of KV transfers pushed to decode workers")
+            self._c_kv_rejects = registry.counter(
+                "kv_migration_rejects_total",
+                "migrated KV pages a decode worker refused (checksum "
+                "mismatch, capacity, stale fence, layout)")
         # Write-ahead fleet journal (crash-recoverable control plane).
         # With recover=True the previous router's journal is folded into
         # a recovery plan BEFORE this router touches any worker: fence
@@ -711,6 +745,11 @@ class Router:
             submitted_s=now, priority=int(priority), ticket=ticket,
             trace=trace,
         )
+        # Disaggregated prefill (no-op without a prefill tier): may
+        # commit the first token and warm the decode target's cache, so
+        # it runs before placement — _assign_locked then submits the
+        # continuation exactly the way a redrive would.
+        self._maybe_disaggregate(rreq)
         try:
             with rreq._lock:
                 replica = self._assign_locked(rreq, exclude=set())
@@ -777,23 +816,209 @@ class Router:
                     pass
         return best
 
+    # -- disaggregated prefill/decode ---------------------------------------
+
+    def _decode_holds_prefix(
+        self, rep: Replica, prompt: List[int], block_size: int
+    ) -> bool:
+        """Would a migration to ``rep`` be redundant — does it already
+        hold at least one full block of this prefix? In-process replicas
+        answer from their cache; remote ones from the KV-placement map
+        (the router's only view of a worker's cache contents)."""
+        digest = prefix_digest(prompt, self.affinity_tokens)
+        with self._kv_home_lock:
+            if self._kv_home.get(digest) == rep.index:
+                return True
+        cache = getattr(rep.engine, "prefix_cache", None)
+        if cache is None:
+            return False
+        try:
+            return cache.peek(prompt) >= block_size
+        except Exception:
+            return False
+
+    def _maybe_disaggregate(self, rreq: RouterRequest) -> None:
+        """Disaggregated prefill: run the prompt's prefill (plus the
+        first token) on a dedicated prefill-tier worker, migrate the
+        resulting KV pages to the decode target, and commit the first
+        token to the client — the continuation then decodes on the
+        warmed target via the ordinary assignment path (``prompt +
+        committed`` with ``max_new`` reduced, the same machinery
+        redrives use).
+
+        Strictly best-effort: every failure mode — no prefill tier, the
+        prefill leg dying mid-flight, a torn/corrupt/rejected transfer —
+        falls back to the colocated path with zero client-visible
+        difference, because greedy decoding makes the first token
+        correct regardless of where the pages ended up, and a decode
+        worker without the pages simply re-prefills. Never raises."""
+        if rreq.max_new < 2:
+            return  # no decode phase to disaggregate
+        prompt = rreq.prompt
+        pre = [
+            r for r in self.replicas
+            if getattr(r, "role", "both") == "prefill"
+            and r.accepting and getattr(r, "kv_capable", False)
+        ]
+        if not pre:
+            return
+        digest = prefix_digest(prompt, self.affinity_tokens)
+        P = max(pre, key=lambda r: _rendezvous_score(digest, r.index))
+        D = self._pick(prompt, set())
+        if (
+            D is None
+            or D.index == P.index
+            or not getattr(D, "kv_capable", False)
+        ):
+            return
+        block_size = int(getattr(D.engine, "block_size", 0) or 0)
+        if block_size < 1 or len(prompt) - 1 < block_size:
+            return  # no full page would migrate; colocated is strictly better
+        if self._decode_holds_prefix(D, prompt, block_size):
+            return  # the target is already warm; migration saves nothing
+        t_mig0 = time.perf_counter()
+        # Prefill leg: loop lane (not client traffic — no fleet ticket,
+        # no fault clock, no frid). max_new=1 so the leg both builds the
+        # KV chain AND yields the greedy first token, which is correct
+        # to commit no matter what happens to the pages.
+        try:
+            leg = P.loop.submit(
+                list(prompt), 1, trace=None, priority=rreq.priority
+            )
+            status, tokens, _info = leg.result(
+                timeout=self.kv_migrate_timeout_s
+            )
+        except Exception:
+            return  # prefill tier died mid-leg: silent colocated fallback
+        if status != "done" or len(tokens) != 1:
+            return
+        t0 = int(tokens[0])
+        inserted = rejected = nbytes = 0
+        reject_reason: Optional[str] = None
+        try:
+            xfer = P.fetch_kv_pages(prompt)
+        except Exception:
+            xfer = None
+        if xfer is not None:
+            nbytes = kv_transfer.transfer_bytes(xfer)
+            try:
+                res = D.push_kv_pages(
+                    xfer, timeout=self.kv_migrate_timeout_s
+                )
+            except Exception:
+                res = None
+            if isinstance(res, dict):
+                inserted = int(res.get("inserted", 0) or 0)
+                rejected = int(res.get("rejected", 0) or 0)
+                if res.get("reason"):
+                    reject_reason = str(res["reason"])
+        # Commit the prefill leg's token: it is the greedy t0 of this
+        # prompt on fleet-identical weights, valid whether or not a
+        # single page survived the trip.
+        with rreq._lock:
+            if rreq.status in TERMINAL_STATUSES or rreq.cancel_requested:
+                return
+            rreq.tokens.append(t0)
+            rreq.out_q.put(("token", t0))
+        if self.journal is not None:
+            # Same frontier record redrives write: a router that dies
+            # right here still resumes from prompt + [t0] on recovery.
+            self.journal.append({
+                "rec": "frontier", "frid": rreq.frid,
+                "tokens": list(rreq.tokens), "redrives": rreq.redrives,
+            })
+        saved_tokens = inserted * block_size
+        with self._counters_lock:
+            self.counters["kv_migrations"] += 1
+            self.counters["kv_pages_migrated"] += inserted
+            self.counters["kv_migration_rejects"] += rejected
+        if inserted and self._c_kv_pages is not None:
+            self._c_kv_pages.inc(inserted)
+        if nbytes and self._c_kv_bytes is not None:
+            self._c_kv_bytes.inc(nbytes)
+        if rejected and self._c_kv_rejects is not None:
+            self._c_kv_rejects.inc(rejected)
+        if rreq.trace is not None:
+            rreq.trace.span(
+                "req.kv_migrate", t_mig0,
+                from_replica=P.index, to_replica=D.index,
+                pages=inserted, bytes=nbytes, rejected=rejected,
+                saved_tokens=saved_tokens,
+            )
+        tid = rreq.trace.trace_id if rreq.trace is not None else None
+        self.decisions.record(
+            "kv_migrate", frid=rreq.frid, from_replica=P.index,
+            to_replica=D.index, pages=inserted, rejected=rejected,
+            trace_id=tid,
+        )
+        if self.bus is not None:
+            self.bus.emit(
+                "kv_migrate", frid=rreq.frid, from_replica=P.index,
+                to_replica=D.index, pages=inserted, bytes=nbytes,
+                rejected=rejected, saved_tokens=saved_tokens,
+            )
+        if rejected:
+            # Rejected pages are DROPPED pages — the decode worker
+            # refused to adopt them (checksum mismatch, capacity, stale
+            # fence). The request is unharmed (it re-prefills), but the
+            # verdict must be auditable.
+            self.decisions.record(
+                "kv_migration_reject", frid=rreq.frid, replica=D.index,
+                rejected=rejected, reason=reject_reason, trace_id=tid,
+            )
+            if self.bus is not None:
+                self.bus.emit(
+                    "kv_migration_reject", frid=rreq.frid,
+                    replica=D.index, rejected=rejected,
+                    reason=reject_reason,
+                )
+        if inserted:
+            with self._kv_home_lock:
+                self._kv_home[digest] = D.index
+                while len(self._kv_home) > self.kv_home_max:
+                    self._kv_home.pop(next(iter(self._kv_home)))
+
     # -- placement ----------------------------------------------------------
 
     def _pick(self, prompt: List[int], tried: Set[int]) -> Optional[Replica]:
+        # Dedicated prefill workers never take client decode traffic —
+        # their capacity is reserved for prefill legs. If the fleet is
+        # SO degraded that only prefill workers accept, serve anyway
+        # (colocated on the prefill worker beats a 429).
         cands = [
             r for r in self.replicas
             if r.index not in tried and r.accepting
+            and getattr(r, "role", "both") != "prefill"
         ]
+        if not cands:
+            cands = [
+                r for r in self.replicas
+                if r.index not in tried and r.accepting
+            ]
         if not cands:
             return None
         digest = prefix_digest(prompt, self.affinity_tokens)
+        loads = {r.index: r.load() for r in cands}
+        min_load = min(loads.values())
+        # KV-placement affinity generalizes prefix-affinity: rendezvous
+        # predicts where a prefix SHOULD live, but a completed migration
+        # records where its pages actually ARE. Honor the recorded home
+        # unless it is spill-margin deeper than the least-loaded
+        # candidate (the same imbalance rule affinity itself obeys).
+        with self._kv_home_lock:
+            home = self._kv_home.get(digest)
+        if home is not None:
+            rep = next((r for r in cands if r.index == home), None)
+            if (
+                rep is not None
+                and loads[rep.index] < min_load + self.spill_margin
+            ):
+                return rep
         by_score = sorted(
             cands, key=lambda r: _rendezvous_score(digest, r.index),
             reverse=True,
         )
         chosen = by_score[0]
-        loads = {r.index: r.load() for r in cands}
-        min_load = min(loads.values())
         if loads[chosen.index] >= min_load + self.spill_margin:
             # Affinity lost to imbalance: take the least-loaded candidate,
             # rendezvous order breaking ties so the spill is deterministic.

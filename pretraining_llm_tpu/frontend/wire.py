@@ -32,7 +32,13 @@ from typing import Any, Dict, Optional
 #   1  original op set (hello/submit/cancel/.../stall + token/end/event)
 #   2  adds the batched span-export frame ({"op": "spans", ...}) and
 #      clock samples in hello/health replies
-PROTO_VERSION = 2
+#   3  adds KV-page migration: the ``kv_fetch`` request (serialize a
+#      cached prefix chain) and ``kv_page`` page-stream frames
+#      (frontend/kv_transfer.py owns the payload layout); pages ride
+#      base64-encoded inside the JSON frame and carry the same ``g``
+#      fence stamp as every other worker frame, so stale-generation
+#      pages are dropped by the existing fence filter
+PROTO_VERSION = 3
 
 # A frame is one JSON op or one token batch — 64 MiB means a corrupt
 # length prefix fails fast instead of attempting a multi-GB recv.

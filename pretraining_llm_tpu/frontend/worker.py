@@ -36,6 +36,20 @@ debug_engine    EngineLoop.debug_engine()
 probe_set       build_probe_set on the worker's own params (serialized
                 prompts/expected) — runs on a side thread so health
                 polls stay live during the reference generates
+kv_fetch        serialize the longest cached KV chain for ``prompt``
+                (frontend/kv_transfer.py): the pages stream back as
+                unsolicited ``kv_page`` frames keyed by ``fetch``=id,
+                then the reply summarizes pages/bytes/frames. Runs on a
+                side thread (device pulls per page) so health polls stay
+                live. proto >= 3 peers only (the parent gates sends).
+kv_page         one inbound frame of a page PUSH (router -> this worker,
+                the decode tier's receive side): frames accumulate per
+                ``xfer`` id; the final frame (the one carrying ``id``)
+                triggers loop-thread adoption behind the prefix-cache
+                publish path and the summary reply. Frames whose fence
+                generation predates this worker's current fence are
+                dropped — stale pages from before an eject never enter
+                the pool.
 shutdown        reply ok, then loop.stop() and exit 0
 stall           NO reply, stop reading frames (fault drill: the parent
                 sees RPC timeouts from a process that is still alive)
@@ -233,6 +247,14 @@ class WorkerServer:
         # Fencing + lease state (attach mode; inert for spawned children
         # until a hello grants a lease).
         self._token = str(spec.get("token") or "")
+        # Disaggregation role ("prefill"|"decode"|"both"); advertised in
+        # the hello so the router can place traffic without config skew.
+        self.role = str(spec.get("role") or "both")
+        # In-flight inbound kv-page transfers: xfer id -> frame list.
+        # Cleared on every (re)connect — a half-received transfer from a
+        # dead connection must never complete against a new sender.
+        self._kv_rx: Dict[Any, list] = {}
+        self._kv_stale_frames = 0
         self._fence = 0
         self._lease_s = 0.0
         self._last_contact = time.monotonic()
@@ -292,6 +314,7 @@ class WorkerServer:
             admission_factory=make_admission,
             fault_injector=faults,
             loop_kwargs=loop_kw,
+            role=self.role,
         )
         self.replica.start()
 
@@ -409,6 +432,7 @@ class WorkerServer:
             except OSError:
                 return
             self._peer_proto = 1  # until this connection's hello says more
+            self._kv_rx.clear()
             with self._wlock:
                 self._conn = conn
                 buffered, self._event_buf = self._event_buf, []
@@ -517,6 +541,8 @@ class WorkerServer:
                         # offset estimator.
                         "proto": PROTO_VERSION,
                         "clock": time.perf_counter(),
+                        # Disaggregation: what traffic this worker takes.
+                        "role": rep.role,
                     },
                 }
             )
@@ -556,6 +582,17 @@ class WorkerServer:
                 name="worker-probeset",
                 daemon=True,
             ).start()
+            return True
+        if op == "kv_fetch":
+            threading.Thread(
+                target=self._handle_kv_fetch,
+                args=(rid, req),
+                name="worker-kvfetch",
+                daemon=True,
+            ).start()
+            return True
+        if op == "kv_page":
+            self._handle_kv_page(req)
             return True
         if op == "shutdown":
             self._send({"id": rid, "ok": True})
@@ -685,6 +722,99 @@ class WorkerServer:
             g=g,
         )
 
+    # ---- KV-page migration (frontend/kv_transfer.py) ----------------
+
+    def _handle_kv_fetch(self, rid: Any, req: Dict[str, Any]) -> None:
+        """Serialize the longest cached chain for the prompt and stream
+        it back as kv_page frames, then the summary reply. Side thread:
+        the snapshot does a device pull per page, and health polls must
+        stay live underneath it."""
+        try:
+            from . import kv_transfer
+
+            prompt = [int(t) for t in req.get("prompt", [])]
+            max_pages = req.get("max_pages")
+            eng = self.replica.engine
+            xfer = kv_transfer.snapshot_chain(
+                eng, prompt,
+                max_pages=int(max_pages) if max_pages else None,
+            )
+            if xfer is None:
+                self._send(
+                    {"id": rid, "ok": {"pages": 0, "bytes": 0, "frames": 0}}
+                )
+                return
+            budget = int(
+                req.get("budget") or kv_transfer.KV_FRAME_BUDGET_BYTES
+            )
+            frames = kv_transfer.split_frames(xfer, budget=budget)
+            for fr in frames:
+                self._send({"op": "kv_page", "fetch": rid, **fr})
+            self._send(
+                {
+                    "id": rid,
+                    "ok": {
+                        "pages": len(xfer["pages"]),
+                        "bytes": kv_transfer.transfer_bytes(xfer),
+                        "frames": len(frames),
+                    },
+                }
+            )
+        except Exception as e:
+            self._send({"id": rid, "error": "runtime", "message": repr(e)})
+
+    def _handle_kv_page(self, req: Dict[str, Any]) -> None:
+        """Receive side of a page push. Interior frames (no ``id``)
+        accumulate; the final frame triggers reassembly + loop-thread
+        adoption. A frame whose fence generation predates the worker's
+        current fence poisons nothing: it is dropped (with its partial
+        transfer) and the sender told why."""
+        xid = req.get("xfer")
+        rid = req.get("id")
+        g = req.get("g")
+        if g is not None and int(g) < self._fence:
+            self._kv_stale_frames += 1
+            self._kv_rx.pop(xid, None)
+            if rid is not None:
+                self._send(
+                    {
+                        "id": rid,
+                        "error": "stale_fence",
+                        "message": (
+                            f"kv_page frame generation {g} predates "
+                            f"fence {self._fence}; pages dropped"
+                        ),
+                    }
+                )
+            return
+        frames = self._kv_rx.setdefault(xid, [])
+        frames.append(req)
+        if rid is None:
+            return
+        self._kv_rx.pop(xid, None)
+        threading.Thread(
+            target=self._adopt_kv_pages,
+            args=(rid, frames),
+            name="worker-kvadopt",
+            daemon=True,
+        ).start()
+
+    def _adopt_kv_pages(self, rid: Any, frames: list) -> None:
+        try:
+            from . import kv_transfer
+
+            xfer = kv_transfer.join_frames(frames)
+            rep = self.replica
+            eng = rep.engine
+            res = rep.loop.run_on_loop(
+                lambda: kv_transfer.adopt_chain(eng, xfer), timeout=30.0
+            )
+            self._send({"id": rid, "ok": res})
+        except ValueError as e:  # torn transfer
+            self._send({"id": rid, "error": "torn", "message": str(e)})
+        except Exception as e:
+            self._send({"id": rid, "error": "runtime", "message": repr(e)})
+
     def _adopt_lease(self, req: Dict[str, Any]) -> None:
         fence = req.get("fence")
         if fence is not None:
@@ -734,6 +864,7 @@ class WorkerServer:
             "generation": int(rep.generation),
             "submits": int(rep.submits),
             "state": rep.state,
+            "role": rep.role,
             "failure": repr(failure) if failure is not None else None,
             "weight_fingerprint0": loop.weight_fingerprint0,
             "weight_fingerprint": loop.weight_fingerprint,
@@ -777,6 +908,16 @@ def main(argv=None) -> int:
         help="shared secret every attaching router must present in its "
         "hello (attach mode)",
     )
+    parser.add_argument(
+        "--role",
+        default="",
+        choices=["", "prefill", "decode", "both"],
+        help="disaggregation role: 'prefill' computes prompts and ships "
+        "KV pages to the decode tier (the router never routes client "
+        "decode traffic here), 'decode' serves clients and receives "
+        "migrated pages, 'both' (default) is the classic colocated "
+        "worker; overrides any role in --spec-json",
+    )
     args = parser.parse_args(argv)
     spec = json.loads(args.spec_json)
     if not isinstance(spec, dict):
@@ -785,6 +926,8 @@ def main(argv=None) -> int:
         spec["listen"] = args.listen
     if args.token:
         spec["token"] = args.token
+    if args.role:
+        spec["role"] = args.role
 
     server = WorkerServer(spec)
     server.announce()

@@ -1251,6 +1251,21 @@ class ServingEngine:
                 )
         return self.alloc.alloc(n)
 
+    def reserve_migration_blocks(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` pool blocks for adopted (migrated-in) KV pages, or
+        None when serving pressure says no. Same watermark as admission:
+        never take the pool below one spare block per active request —
+        a migration is an optimization and must lose to live decode.
+        Loop-thread only (callers come through EngineLoop.run_on_loop);
+        the blocks are expected to be published into the prefix cache
+        (where they become cold, i.e. reclaimable) or freed by the
+        caller — they must not leak as unowned live blocks."""
+        if n < 1:
+            return None
+        if self._cache_available() - n < self.n_active:
+            return None
+        return self._cache_alloc(n)
+
     def _admission_capacity(self) -> int:
         """How many queue heads could be admitted RIGHT NOW under the
         free-row + watermark rules, without committing anything — the

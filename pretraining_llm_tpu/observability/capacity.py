@@ -67,6 +67,12 @@ DECISION_KINDS = (
     # dropped cache block costs its next hit a private re-prefill.
     "quarantine",          # sentinel pulled a divergent replica from service
     "drop_corrupt_block",  # cached KV block failed verify-on-acquire; dropped
+
+    # Disaggregated prefill/decode (frontend/kv_transfer.py): migrating
+    # a prefix's KV pages saves the decode tier that prefill; a rejected
+    # page costs only a re-prefill, never a wrong token.
+    "kv_migrate",           # prefill-tier pages pushed to a decode worker
+    "kv_migration_reject",  # decode worker refused migrated pages (checksum/capacity/fence)
 )
 
 

@@ -97,6 +97,14 @@ EVENT_KINDS = (
     "upgrade_vetted",       # new weights passed golden probes: replica, detail
     "upgrade_refused",      # probes failed; upgrade rejected: replica, reason
     "upgrade_rolled_back",  # old weights restored (or ejected): replica, restored
+    # Disaggregated prefill/decode (frontend/kv_transfer.py + router).
+    # kv_migrate records each prefill-tier page push (frid, from/to
+    # replica, pages, bytes, saved_tokens); a nonzero reject count also
+    # emits kv_migration_reject with the decode worker's refusal reason
+    # (checksum_mismatch/capacity/stale fence) — the proof that corrupt
+    # pages were dropped rather than served.
+    "kv_migrate",           # KV pages migrated prefill->decode: frid, pages, bytes
+    "kv_migration_reject",  # decode worker refused migrated pages: replica, reason
     "fault_fired",               # armed corruption actually mutated engine state
     "integrity_probe",           # probe completed: replica, ok, probe, n_tokens
     "integrity_quarantine",      # replica pulled from service: replica, reason

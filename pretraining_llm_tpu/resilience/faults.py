@@ -67,11 +67,23 @@ SERVING_FAULT_KINDS = (
                       # no RST/EOF, so only leases + fencing can detect it
                       # (heal via FleetAction kind="heal" or replica.heal())
     "wire_delay",     # add per-recv delay + jitter (slow WAN link drill)
+    # KV-migration corruption (disaggregation drill): flip bytes in the
+    # next in-flight kv_page transfer pushed THROUGH the scoped replica's
+    # connection (armed at the Nth accepted submission, consumed by the
+    # sender side of the next push) — the receiver must detect the digest
+    # mismatch, drop the page, and let the request re-prefill. Works for
+    # in-process and process fleets alike: the flip happens on the
+    # serialized transfer, before (or instead of) the wire.
+    "corrupt_kv_migration",
 )
 
 # The subset above that needs a process boundary to mean anything.
+# corrupt_kv_migration is sender-side (the parent corrupts the serialized
+# transfer before pushing), so in process fleets it must ride in the
+# PARENT's plan half, like the kill/stall/sever kinds.
 PROCESS_SERVING_FAULT_KINDS = (
     "worker_kill", "worker_stall", "conn_drop", "partition", "wire_delay",
+    "corrupt_kv_migration",
 )
 
 # How long an injected hang blocks the host loop. Effectively forever next to
@@ -310,6 +322,7 @@ class ServingFaultInjector:
         self._storm: Dict[int, int] = {}         # replica -> rejects left
         self._corrupt: Dict[int, List[str]] = {}  # replica -> corruption queue
         self._process: Dict[int, List[str]] = {}  # replica -> process faults
+        self._kv_corrupt: Dict[int, int] = {}    # replica -> armed kv flips
         self._engines: Dict[int, Any] = {}       # replica -> live engine handle
 
     def attach_engine(self, replica: int, engine: Any) -> None:
@@ -340,6 +353,13 @@ class ServingFaultInjector:
                     )
                 if f.kind in ("replica_crash", "replica_hang"):
                     self._armed.setdefault(replica, []).append(f.kind)
+                elif f.kind == "corrupt_kv_migration":
+                    # Sender-side: consumed by the next kv-page push
+                    # through this replica (take_kv_corruption), not by
+                    # the generic process-fault drain.
+                    self._kv_corrupt[replica] = (
+                        self._kv_corrupt.get(replica, 0) + 1
+                    )
                 elif f.kind in PROCESS_SERVING_FAULT_KINDS:
                     self._process.setdefault(replica, []).append(f.kind)
                 elif f.kind in (
@@ -372,6 +392,14 @@ class ServingFaultInjector:
         this, which is exactly why process kinds are no-ops there."""
         with self._lock:
             return self._process.pop(replica, [])
+
+    def take_kv_corruption(self, replica: int) -> int:
+        """Drain the armed ``corrupt_kv_migration`` count for ``replica``.
+        Called by the kv-page push path (Replica.push_kv_pages /
+        RemoteReplica.push_kv_pages) right before serializing onto the
+        wire; a nonzero return means: flip bytes in this transfer."""
+        with self._lock:
+            return self._kv_corrupt.pop(replica, 0)
 
     def wrap_tick(self, replica: int, tick: Any) -> Any:
         """Shim for ``engine.pipeline_tick``: checks armed actions before

@@ -1314,3 +1314,118 @@ grep -q "== fleet trace ==" "$OBS_TMP/fleet_trace_report.out" || {
     echo "obs_report --fleet-trace missing the fleet trace section"; exit 1; }
 grep -Eq "redriven=[1-9]" "$OBS_TMP/fleet_trace_report.out" || {
     echo "obs_report --fleet-trace saw no redriven lineage tree"; exit 1; }
+
+# Disaggregation gate: a real prefill/decode tier split over TCP. One
+# prefill worker + one decode worker (separate processes, roles in the
+# spec), hot-prefix traffic through real HTTP: at least one KV page must
+# migrate prefill->decode, every request must be served by the decode
+# tier with greedy outputs BIT-IDENTICAL to a colocated single engine,
+# /metrics must stay lint-clean with the typed migration counters, and
+# the offline auditor must join each migration to the prefill it saved.
+JAX_PLATFORMS=cpu OBS_TMP="$OBS_TMP" python - <<'EOF'
+import dataclasses, json, os, threading, urllib.request
+import jax
+import numpy as np
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.admission import AdmissionController
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.export import lint_exposition
+from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+
+tmp = os.environ["OBS_TMP"]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+ekw = {"max_batch": 2, "n_blocks": 24, "block_size": 8,
+       "temperature": 0.0, "steps_per_sched": 4, "pipeline_depth": 2,
+       "prefix_cache": True, "kv_checksum": True}
+
+# Hot-prefix workload: six requests sharing a 12-token prefix — one
+# migration of the shared chain warms the decode tier for the rest.
+rng = np.random.default_rng(20)
+head = rng.integers(0, cfg.vocab_size, size=12).tolist()
+prompts = [head + rng.integers(0, cfg.vocab_size, size=3).tolist()
+           for _ in range(6)]
+n_new = 8
+
+# Colocated reference: one engine, no fleet, no migration.
+eng = ServingEngine(params, cfg, **ekw)
+rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+ref = {rids[r]: t for r, t in eng.run().items()}
+
+bus = EventBus(os.path.join(tmp, "disagg_events.jsonl"))
+registry = MetricsRegistry("pllm_serving_")
+def spec(role):
+    return {"preset": "tiny", "init_seed": 0,
+            "model_overrides": {"compute_dtype": "float32"},
+            "engine": dict(ekw), "admission": {"max_queue_depth": 8},
+            "role": role}
+replicas = [RemoteReplica(0, spec("prefill"), bus=bus),
+            RemoteReplica(1, spec("decode"), bus=bus)]
+router = Router(replicas, bus=bus, registry=registry,
+                admission=AdmissionController(max_queue_depth=16),
+                eject_backoff_s=60.0).start()
+assert replicas[0].role == "prefill" and replicas[1].role == "decode"
+assert all(rep.kv_capable for rep in replicas)
+gw = ServingGateway(router, port=0)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+outs = {}
+def post(i, p):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": p, "max_new_tokens": n_new}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as r:
+        outs[i] = json.loads(r.read())
+threads = [threading.Thread(target=post, args=(i, p))
+           for i, p in enumerate(prompts)]
+for t in threads: t.start()
+for t in threads: t.join(timeout=300)
+assert not any(t.is_alive() for t in threads), "a disagg request hung"
+
+for i in range(len(prompts)):
+    body = outs[i]
+    assert body["status"] == "done", body
+    # bit-identity vs colocated: migration must never change a token
+    assert body["tokens"] == ref[i], (i, body["tokens"], ref[i])
+    # the prefill tier never serves client traffic
+    assert body["replica"] == 1, body
+
+assert router.counters["kv_migrations"] >= 1, router.counters
+assert router.counters["kv_pages_migrated"] >= 1, router.counters
+assert router.counters["kv_migration_rejects"] == 0, router.counters
+
+with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+    text = r.read().decode()
+problems = lint_exposition(text)
+assert not problems, problems
+assert "pllm_serving_kv_pages_migrated_total" in text, text[:400]
+assert "pllm_serving_kv_migrated_bytes_total" in text, text[:400]
+assert "pllm_serving_kv_migration_rejects_total" in text, text[:400]
+
+gw.stop(); router.stop(); bus.close()
+print(f"disaggregation smoke ok: migrations="
+      f"{router.counters['kv_migrations']}, pages="
+      f"{router.counters['kv_pages_migrated']}, bit-identical over TCP")
+EOF
+
+if pgrep -f "pretraining_llm_tpu.frontend.worker" > /dev/null; then
+    echo "orphaned worker processes left after disaggregation gate:"
+    pgrep -af "pretraining_llm_tpu.frontend.worker"
+    exit 1
+fi
+
+# The offline auditor must report the migration section: every
+# kv_migrate joined to its request, with the prefill tokens it saved.
+python scripts/obs_report.py --fleet --strict \
+    "$OBS_TMP/disagg_events.jsonl" > "$OBS_TMP/disagg_report.out"
+grep -q "lost=0" "$OBS_TMP/disagg_report.out" || {
+    echo "obs_report --fleet (disagg) did not report lost=0"; exit 1; }
+grep -q "kv migration" "$OBS_TMP/disagg_report.out" || {
+    echo "obs_report --fleet missing the kv migration section"; exit 1; }

@@ -1356,6 +1356,59 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             ),
         }
 
+    # Disaggregated prefill/decode: join each kv_migrate to the request
+    # whose prefill it saved (saved_tokens = pages * block_size the
+    # decode tier did NOT recompute), and every reject to its typed
+    # refusal reason — a reasonless drop is unauditable, so it is
+    # strict, as is a migration for a request the router never saw.
+    migrates = [e for e in events if e.get("event") == "kv_migrate"]
+    mig_rejects = [
+        e for e in events if e.get("event") == "kv_migration_reject"
+    ]
+    kv_migration = None
+    if migrates or mig_rejects:
+        mig_rows: List[Dict[str, Any]] = []
+        for e in migrates:
+            frid = e.get("frid")
+            if frid not in sub_frids:
+                problems.append(
+                    f"kv_migrate references unknown frid {frid}"
+                )
+            term = term_frids.get(frid)
+            mig_rows.append({
+                "frid": frid,
+                "from_replica": e.get("from_replica"),
+                "to_replica": e.get("to_replica"),
+                "pages": int(e.get("pages", 0)),
+                "bytes": int(e.get("bytes", 0)),
+                "rejected": int(e.get("rejected", 0)),
+                "saved_tokens": int(e.get("saved_tokens", 0)),
+                "request_status": (
+                    str(term.get("status")) if term is not None else None
+                ),
+            })
+        reject_reasons: Dict[str, int] = {}
+        for e in mig_rejects:
+            reason = e.get("reason")
+            if not reason:
+                problems.append(
+                    f"kv_migration_reject for frid {e.get('frid')} "
+                    f"carries no reason (unauditable page drop)"
+                )
+            reason = str(reason or "?")
+            reject_reasons[reason] = reject_reasons.get(reason, 0) + 1
+        kv_migration = {
+            "migrations": len(migrates),
+            "pages_migrated": sum(r["pages"] for r in mig_rows),
+            "bytes_migrated": sum(r["bytes"] for r in mig_rows),
+            "saved_prefill_tokens": sum(
+                r["saved_tokens"] for r in mig_rows
+            ),
+            "pages_rejected": sum(r["rejected"] for r in mig_rows),
+            "reject_reasons": reject_reasons,
+            "migrations_detail": mig_rows,
+        }
+
     return {
         "n_submitted": len(submits),
         "n_terminal": len(terms),
@@ -1369,6 +1422,7 @@ def build_fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "upgrades": upgrades,
         "partitions": partitions,
         "journal": journal,
+        "kv_migration": kv_migration,
         "problems": problems,
     }
 
@@ -1471,6 +1525,25 @@ def print_fleet_report(report: Dict[str, Any]) -> None:
             f"replays={j['replays']} "
             f"tokens_resumed_from={j['tokens_resumed_from']}"
         )
+    kv = report.get("kv_migration")
+    if kv:
+        print("== kv migration ==")
+        print(
+            f"migrations={kv['migrations']} "
+            f"pages={kv['pages_migrated']} "
+            f"bytes={kv['bytes_migrated']} "
+            f"saved_prefill_tokens={kv['saved_prefill_tokens']} "
+            f"pages_rejected={kv['pages_rejected']}"
+        )
+        for m in kv["migrations_detail"]:
+            print(
+                f"  frid {m['frid']}: replica {m['from_replica']} -> "
+                f"{m['to_replica']}, {m['pages']} pages "
+                f"({m['bytes']} bytes), saved {m['saved_tokens']} "
+                f"prefill tokens, request {m['request_status']}"
+            )
+        for reason, n in sorted(kv["reject_reasons"].items()):
+            print(f"  rejected: {reason:<32} {n}")
     for p in report["problems"]:
         print(f"!! {p}")
 

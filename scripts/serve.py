@@ -142,6 +142,15 @@ def main() -> None:
                         "behind a socket (real kill -9 fault domain, "
                         "rolling weight upgrades). Router/gateway "
                         "behavior is identical (default: config)")
+    parser.add_argument("--replica_roles", default=None,
+                        help="(--http, replicas>1) comma-separated "
+                        "disaggregation roles, one per replica (or one "
+                        "value for all): prefill|decode|both, e.g. "
+                        "'prefill,decode'. Prefill workers take no "
+                        "client decode traffic; the router runs prompt "
+                        "prefills on them and migrates the KV pages to "
+                        "the decode target over the wire "
+                        "(default: config)")
     parser.add_argument("--attach", default=None,
                         help="(--http, replica_mode=process) attach to "
                         "pre-spawned workers (worker.py --listen) instead "
@@ -367,6 +376,25 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     attach_token = pick(args.attach_token, fc.attach_token)
     lease_s = pick(args.lease_s, fc.lease_s)
     journal_path = pick(args.journal_path, fc.journal_path)
+    roles_raw = pick(args.replica_roles, getattr(fc, "replica_roles", ""))
+    roles = (
+        [r.strip() for r in str(roles_raw).split(",") if r.strip()]
+        if roles_raw else []
+    )
+    if roles:
+        if len(roles) == 1:
+            roles = roles * n_replicas
+        if len(roles) != n_replicas:
+            raise SystemExit(
+                f"--replica_roles lists {len(roles)} roles for "
+                f"{n_replicas} replicas"
+            )
+        bad = [r for r in roles if r not in ("prefill", "decode", "both")]
+        if bad:
+            raise SystemExit(
+                f"--replica_roles: unknown role(s) {bad}; expected "
+                "prefill|decode|both"
+            )
     attach_addrs = [a.strip() for a in attach.split(",")] if attach else []
     if attach_addrs:
         if replica_mode != "process":
@@ -483,13 +511,17 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
         )
         def _rep_spec(i):
             # Attach mode: each replica gets its own pre-spawned worker
-            # address (plus the shared token); spawn mode shares the spec.
-            if not attach_addrs:
+            # address (plus the shared token); spawn mode shares the spec
+            # unless per-replica roles differentiate it.
+            if not attach_addrs and not roles:
                 return worker_spec
             s = dict(worker_spec)
-            s["attach"] = attach_addrs[i]
-            if attach_token:
-                s["token"] = attach_token
+            if roles:
+                s["role"] = roles[i]
+            if attach_addrs:
+                s["attach"] = attach_addrs[i]
+                if attach_token:
+                    s["token"] = attach_token
             return s
 
         # All RemoteReplicas share the tracer's recorder (or the process
@@ -518,6 +550,7 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
                 registry_labels={"quant_dtype": quantize},
                 admission_factory=make_admission, fault_injector=faults,
                 loop_kwargs=loop_kwargs,
+                role=roles[i] if roles else "both",
             )
             for i in range(n_replicas)
         ]

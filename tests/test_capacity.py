@@ -115,6 +115,8 @@ def test_capacity_event_kinds_documented():
         "quarantine", "drop_corrupt_block",
         # process-worker fleet (frontend/worker.py + router)
         "fleet_drain", "upgrade_refused",
+        # disaggregated prefill/decode tiers (frontend/router.py)
+        "kv_migrate", "kv_migration_reject",
     }
 
 

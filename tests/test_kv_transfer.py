@@ -1,0 +1,585 @@
+"""Disaggregated prefill/decode tiers: KV-page migration over the wire.
+
+The correctness bar is the fleet tests' bit-identity contract extended
+across KV state crossing a process boundary: a fleet split into prefill
+and decode tiers must produce greedy outputs BIT-IDENTICAL to a single
+colocated engine — including when the prefill worker is killed mid-leg
+(silent colocated fallback) and when a migrated page arrives corrupted
+(detected by its transported digest, dropped, re-prefilled — corruption
+may cost latency but never a wrong token).
+
+Layers under test, bottom-up: the pure framing codec (split/join, torn
+transfers, the wire-level frame cap), the worker's fence filter for
+stale kv_page frames, snapshot/adopt against real engines (digest
+parity with the acquire-side checksum algorithm), the router's
+disaggregation orchestration in-process, and — marked ``slow`` like the
+other subprocess drills — the same over real worker processes and TCP.
+"""
+
+import base64
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend import kv_transfer, wire
+from pretraining_llm_tpu.frontend.kv_transfer import (
+    adopt_chain,
+    corrupt_first_page,
+    join_frames,
+    snapshot_chain,
+    split_frames,
+)
+from pretraining_llm_tpu.frontend.remote_replica import RemoteReplica
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.frontend.worker import WorkerServer
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
+from pretraining_llm_tpu.resilience.integrity import kv_block_digest
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _engine_factory(params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_blocks", 24)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("steps_per_sched", 4)
+    kw.setdefault("pipeline_depth", 2)
+
+    def factory():
+        return ServingEngine(params, CFG, temperature=0.0, **kw)
+
+    return factory
+
+
+def _undisturbed(params, prompts, n_new, **kw):
+    eng = _engine_factory(params, **kw)()
+    rids = {eng.submit(p, n_new): i for i, p in enumerate(prompts)}
+    out = eng.run()
+    return {rids[rid]: toks for rid, toks in out.items()}
+
+
+def _shared_prefix_prompts(n=3, shared=12, tail=3, seed=42):
+    """Hot-prefix workload: migrating the shared chain once warms the
+    decode tier for every sibling."""
+    rng = np.random.default_rng(seed)
+    head = rng.integers(0, CFG.vocab_size, size=shared).tolist()
+    return [
+        head + rng.integers(0, CFG.vocab_size, size=tail).tolist()
+        for _ in range(n)
+    ]
+
+
+def _distinct_prompts(n=3, length=13, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, CFG.vocab_size, size=length).tolist()
+        for _ in range(n)
+    ]
+
+
+# -- framing codec (pure python, no JAX, no engine) --------------------------
+
+
+def _toy_xfer(n_pages=4, leaf_shapes=((2, 8, 4), (8,)), seed=0):
+    """A synthetic transfer with real digests over random int8 pages —
+    ~72 payload bytes per page with the default shapes."""
+    rng = np.random.default_rng(seed)
+    layout = [{"dtype": "int8", "shape": list(s)} for s in leaf_shapes]
+    pages = []
+    for _ in range(n_pages):
+        arrays = [
+            rng.integers(-128, 128, size=s, dtype=np.int8)
+            for s in leaf_shapes
+        ]
+        pages.append({
+            "digest": kv_transfer._page_digest(arrays),
+            "leaves": [
+                base64.b64encode(a.tobytes()).decode("ascii")
+                for a in arrays
+            ],
+        })
+    return {
+        "v": kv_transfer.XFER_VERSION,
+        "block_size": 8,
+        "tokens": rng.integers(0, 100, size=n_pages * 8).tolist(),
+        "layout": layout,
+        "pages": pages,
+    }
+
+
+def test_split_join_roundtrip_respects_budget():
+    xfer = _toy_xfer(n_pages=4)
+    frames = split_frames(xfer, budget=150)  # two 72-byte pages per frame
+    assert len(frames) == 2
+    assert [f["seq"] for f in frames] == [0, 1]
+    assert all(f["n_frames"] == 2 for f in frames)
+    assert all(len(f["pages"]) == 2 for f in frames)
+    # header rides frame 0 only
+    assert frames[0]["tokens"] == xfer["tokens"]
+    assert "tokens" not in frames[1]
+    # arrival order does not matter
+    assert join_frames(frames[::-1]) == xfer
+    assert join_frames(frames) == xfer
+
+
+def test_split_oversized_page_still_travels():
+    # A single page above the budget gets a frame of its own instead of
+    # being dropped; the wire-level frame cap is the real backstop.
+    xfer = _toy_xfer(n_pages=3)
+    frames = split_frames(xfer, budget=10)
+    assert len(frames) == 3
+    assert all(len(f["pages"]) == 1 for f in frames)
+    assert join_frames(frames) == xfer
+    with pytest.raises(ValueError, match="budget"):
+        split_frames(xfer, budget=0)
+
+
+def test_split_empty_transfer_keeps_header_frame():
+    xfer = _toy_xfer(n_pages=1)
+    xfer["pages"] = []
+    xfer["tokens"] = []
+    frames = split_frames(xfer)
+    assert len(frames) == 1 and frames[0]["pages"] == []
+    assert join_frames(frames)["pages"] == []
+
+
+def test_join_torn_transfers_rejected_as_a_unit():
+    frames = split_frames(_toy_xfer(n_pages=3), budget=80)
+    assert len(frames) == 3
+    with pytest.raises(ValueError, match="missing frames"):
+        join_frames(frames[:-1])
+    with pytest.raises(ValueError, match="duplicate seq"):
+        join_frames(frames + [frames[1]])
+    bad = [dict(f) for f in frames]
+    bad[2]["n_frames"] = 4
+    with pytest.raises(ValueError, match="inconsistent n_frames"):
+        join_frames(bad)
+    bad = [dict(f) for f in frames]
+    bad[1]["seq"] = 9
+    with pytest.raises(ValueError, match="bad seq"):
+        join_frames(bad)
+    headless = [dict(f) for f in frames]
+    del headless[0]["tokens"]
+    with pytest.raises(ValueError, match="header missing"):
+        join_frames(headless)
+    with pytest.raises(ValueError, match="no frames"):
+        join_frames([])
+
+
+def test_kv_page_frame_above_wire_cap_refused():
+    # One page whose base64 payload alone exceeds MAX_FRAME_BYTES must
+    # be refused at encode time (ProtocolError), not sent as garbage.
+    frame = {
+        "op": "kv_page", "seq": 0, "n_frames": 1,
+        "pages": [{"digest": "0" * 32,
+                   "leaves": ["A" * (wire.MAX_FRAME_BYTES + 1)]}],
+    }
+    with pytest.raises(wire.ProtocolError, match="exceeds MAX_FRAME_BYTES"):
+        wire.encode_frame(frame)
+
+
+def test_corrupt_first_page_breaks_digest_only():
+    xfer = _toy_xfer(n_pages=2)
+    before = [
+        {"digest": p["digest"], "leaves": list(p["leaves"])}
+        for p in xfer["pages"]
+    ]
+    assert corrupt_first_page(xfer)
+    # exactly one byte of page 0 leaf 0 flipped; digest still claims the
+    # ORIGINAL bytes (that lie is what the receiver must catch)
+    raw0 = base64.b64decode(before[0]["leaves"][0])
+    raw1 = base64.b64decode(xfer["pages"][0]["leaves"][0])
+    assert raw1[0] == raw0[0] ^ 0xFF and raw1[1:] == raw0[1:]
+    assert xfer["pages"][0]["digest"] == before[0]["digest"]
+    assert xfer["pages"][1] == before[1]
+    assert not corrupt_first_page({"pages": []})
+
+
+def test_worker_drops_stale_fence_kv_pages():
+    # The fence filter runs before any frame is accumulated, so a page
+    # push racing a redrive fence bump can never poison the pool. Bare
+    # WorkerServer: the stale path touches only fence/rx bookkeeping.
+    ws = WorkerServer.__new__(WorkerServer)
+    ws._fence = 3
+    ws._kv_rx = {}
+    ws._kv_stale_frames = 0
+    sent = []
+    ws._send = lambda payload, g=None: sent.append(payload)
+    # interior frame at the current generation accumulates silently
+    ws._handle_kv_page(
+        {"xfer": "x1", "g": 3, "seq": 0, "n_frames": 2, "pages": []}
+    )
+    assert "x1" in ws._kv_rx and not sent
+    # stale FINAL frame: the whole partial transfer is dropped and the
+    # sender told why
+    ws._handle_kv_page(
+        {"xfer": "x1", "g": 2, "id": 7, "seq": 1, "n_frames": 2,
+         "pages": []}
+    )
+    assert ws._kv_rx == {} and ws._kv_stale_frames == 1
+    assert sent[-1] == {
+        "id": 7, "error": "stale_fence",
+        "message": sent[-1]["message"],
+    }
+    assert "predates fence 3" in sent[-1]["message"]
+    # stale interior frame: dropped without a reply (nothing to nack)
+    sent.clear()
+    ws._handle_kv_page(
+        {"xfer": "x2", "g": 0, "seq": 0, "n_frames": 1, "pages": []}
+    )
+    assert ws._kv_rx == {} and not sent and ws._kv_stale_frames == 2
+
+
+# -- snapshot/adopt against real engines -------------------------------------
+
+
+_KV_KW = {"prefix_cache": True, "kv_checksum": True}
+
+
+def _warm_engine(params, prompt, n_new=4, **kw):
+    eng = _engine_factory(params, **{**_KV_KW, **kw})()
+    eng.submit(prompt, n_new)
+    eng.run()
+    return eng
+
+
+def test_snapshot_digest_parity_with_acquire_side_checksum(params):
+    # The transported digest must be byte-for-byte the kv_block_digest
+    # the receiver's verify-on-acquire recomputes, or every migrated
+    # page would look corrupt on first local hit.
+    prompt = _distinct_prompts(1, length=20)[0]
+    eng = _warm_engine(params, prompt)
+    xfer = snapshot_chain(eng, prompt)
+    assert xfer is not None and len(xfer["pages"]) == 2  # (20-1)//8 blocks
+    assert xfer["block_size"] == 8
+    assert xfer["tokens"] == prompt[:16]
+    _, blocks = eng.prefix_cache.acquire(prompt)
+    try:
+        assert len(blocks) == 2
+        for page, b in zip(xfer["pages"], blocks):
+            assert page["digest"] == kv_block_digest(eng.pools, b)
+            assert page["digest"] == eng.prefix_cache.checksum_of(b)
+    finally:
+        eng.prefix_cache.release_shared(blocks)
+
+
+def test_snapshot_without_cache_or_coverage_is_none(params):
+    prompt = _distinct_prompts(1, length=20)[0]
+    nocache = _engine_factory(params, prefix_cache=False)()
+    assert snapshot_chain(nocache, prompt) is None
+    cold = _engine_factory(params, **_KV_KW)()
+    assert snapshot_chain(cold, prompt) is None  # nothing cached yet
+
+
+def test_adopt_roundtrip_is_bit_identical(params):
+    prompt = _distinct_prompts(1, length=20)[0]
+    n_new = 6
+    ref = _undisturbed(params, [prompt], n_new, **_KV_KW)
+    src = _warm_engine(params, prompt, n_new=n_new)
+    xfer = snapshot_chain(src, prompt)
+    dst = _engine_factory(params, **_KV_KW)()
+    res = adopt_chain(dst, xfer)
+    assert res == {
+        "inserted": 2, "rejected": 0, "published": 2, "reason": "",
+    }
+    assert dst.stats["kv_pages_adopted"] == 2
+    assert dst.stats.get("kv_pages_rejected", 0) == 0
+    # re-adopting the same chain publishes nothing new (first writer
+    # wins; the duplicate blocks go straight back to the allocator)
+    res2 = adopt_chain(dst, snapshot_chain(src, prompt))
+    assert res2["inserted"] == 2 and res2["published"] == 0
+    # decoding on the warmed receiver reproduces the reference exactly
+    rid = dst.submit(prompt, n_new)
+    assert dst.run()[rid] == ref[0]
+
+
+def test_adopt_rejects_are_typed_and_counted(params):
+    prompt = _distinct_prompts(1, length=20)[0]
+    src = _warm_engine(params, prompt)
+    xfer = snapshot_chain(src, prompt)
+
+    nocache = _engine_factory(params, prefix_cache=False)()
+    res = adopt_chain(nocache, dict(xfer))
+    assert res["inserted"] == 0 and res["reason"] == "no_prefix_cache"
+    assert nocache.stats["kv_pages_rejected"] == 2
+
+    wrong_bs = _engine_factory(params, block_size=16, **_KV_KW)()
+    res = adopt_chain(wrong_bs, dict(xfer))
+    assert res["reason"] == "block_size_mismatch" and res["rejected"] == 2
+
+    dst = _engine_factory(params, **_KV_KW)()
+    res = adopt_chain(dst, {**xfer, "v": 99})
+    assert res["reason"] == "version_mismatch" and res["inserted"] == 0
+
+
+def test_adopt_truncates_chain_at_first_corrupt_page(params):
+    prompt = _distinct_prompts(1, length=20)[0]
+    n_new = 6
+    ref = _undisturbed(params, [prompt], n_new, **_KV_KW)
+    src = _warm_engine(params, prompt, n_new=n_new)
+
+    # page 0 corrupt: nothing adoptable
+    xfer = snapshot_chain(src, prompt)
+    assert corrupt_first_page(xfer)
+    dst = _engine_factory(params, **_KV_KW)()
+    res = adopt_chain(dst, xfer)
+    assert res == {
+        "inserted": 0, "rejected": 2, "published": 0,
+        "reason": "checksum_mismatch",
+    }
+    assert dst.stats["kv_pages_rejected"] == 2
+
+    # page 1 corrupt: the clean prefix (page 0) is adopted, the rest
+    # dropped — and decoding on the receiver is STILL bit-identical,
+    # because the dropped span simply re-prefills
+    xfer = snapshot_chain(src, prompt)
+    raw = bytearray(base64.b64decode(xfer["pages"][1]["leaves"][0]))
+    raw[0] ^= 0xFF
+    xfer["pages"][1]["leaves"][0] = base64.b64encode(bytes(raw)).decode()
+    dst = _engine_factory(params, **_KV_KW)()
+    res = adopt_chain(dst, xfer)
+    assert res == {
+        "inserted": 1, "rejected": 1, "published": 1,
+        "reason": "checksum_mismatch",
+    }
+    assert dst.stats["kv_pages_adopted"] == 1
+    assert dst.stats["kv_pages_rejected"] == 1
+    rid = dst.submit(prompt, n_new)
+    assert dst.run()[rid] == ref[0]
+
+
+# -- in-process disaggregated fleet (router orchestration) -------------------
+
+
+def _disagg_fleet(params, faults=None, bus=None, engine_kw=None, **router_kw):
+    factory = _engine_factory(params, **{**_KV_KW, **(engine_kw or {})})
+    reps = [
+        Replica(0, factory, role="prefill", bus=bus, fault_injector=faults),
+        Replica(1, factory, role="decode", bus=bus, fault_injector=faults),
+    ]
+    router_kw.setdefault("eject_backoff_s", 0.1)
+    return Router(reps, bus=bus, **router_kw)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("cache", [False, True], ids=["nocache", "cache"])
+def test_disagg_bit_identity_grid(params, depth, cache):
+    kw = {
+        "pipeline_depth": depth, "prefix_cache": cache,
+        "kv_checksum": cache,
+    }
+    prompts = _shared_prefix_prompts(3)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new, **kw)
+    router = _disagg_fleet(params, engine_kw=kw)
+    with router:
+        results = []
+        for p in prompts:  # serial: deterministic migration/warmth order
+            results.append(router.submit(p, n_new).result(timeout=120))
+    reps = router.replicas
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+        # the prefill tier never serves client traffic
+        assert info["replica"] == 1
+    if cache:
+        # the shared chain migrated once; the siblings found the decode
+        # tier already warm and skipped the wire entirely
+        assert router.counters["kv_migrations"] == 1
+        assert router.counters["kv_pages_migrated"] >= 1
+        assert router.counters["kv_migration_rejects"] == 0
+        assert reps[1].engine.stats["kv_pages_adopted"] >= 1
+    else:
+        # nothing snapshotable without a prefix cache: legs run but no
+        # page ever crosses, and outputs are unaffected either way
+        assert router.counters["kv_pages_migrated"] == 0
+
+
+def test_corrupt_kv_migration_never_serves_wrong_tokens(params):
+    # The drill: the fault injector flips one byte of the first migrated
+    # page while its digest still claims the original bytes. The decode
+    # tier must detect, drop, re-prefill — outputs stay bit-identical
+    # and the drop is visible as counters + a typed reject event.
+    prompts = _distinct_prompts(3)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    events = []
+    bus = EventBus()
+    bus.subscribe(lambda ev: events.append(ev))
+    faults = ServingFaultInjector("corrupt_kv_migration@req1:r1", bus=bus)
+    router = _disagg_fleet(params, faults=faults, bus=bus)
+    with router:
+        results = []
+        for p in prompts:
+            results.append(router.submit(p, n_new).result(timeout=120))
+    reps = router.replicas
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+    assert router.counters["kv_migrations"] == 3
+    assert router.counters["kv_migration_rejects"] >= 1
+    assert reps[1].engine.stats["kv_pages_rejected"] >= 1
+    assert reps[1].engine.stats["kv_pages_adopted"] >= 1
+    kinds = [ev.get("event") for ev in events]
+    assert "kv_migrate" in kinds
+    assert "fault_fired" in kinds
+    rejects = [ev for ev in events if ev.get("event") == "kv_migration_reject"]
+    assert rejects and rejects[0]["reason"] == "checksum_mismatch"
+    assert rejects[0]["replica"] == 1
+    counts = router.decisions.counts_snapshot()
+    assert counts.get("kv_migrate") == 3
+    assert counts.get("kv_migration_reject", 0) >= 1
+
+
+def test_prefill_fetch_failure_falls_back_colocated(params):
+    # A prefill tier that dies between the leg and the page pull costs
+    # nothing but the wasted leg: the decode tier re-prefills.
+    prompts = _distinct_prompts(2)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    router = _disagg_fleet(params)
+
+    def boom(prompt, **kw):
+        raise RuntimeError("prefill tier vanished")
+
+    router.replicas[0].fetch_kv_pages = boom
+    with router:
+        results = []
+        for p in prompts:
+            results.append(router.submit(p, n_new).result(timeout=120))
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+    assert router.counters["kv_pages_migrated"] == 0
+
+
+def test_single_tier_fleet_never_migrates(params):
+    # No replica advertises role=prefill: the disaggregation path must
+    # stay entirely cold (zero legs, zero counters).
+    prompts = _distinct_prompts(2)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    factory = _engine_factory(params, **_KV_KW)
+    reps = [Replica(i, factory) for i in range(2)]
+    router = Router(reps, eject_backoff_s=0.1)
+    with router:
+        results = [
+            router.submit(p, n_new).result(timeout=120) for p in prompts
+        ]
+    for i, (status, tokens, _info) in enumerate(results):
+        assert status == "done" and tokens == ref[i]
+    assert router.counters["kv_migrations"] == 0
+
+
+# -- subprocess drills: real workers, real TCP -------------------------------
+
+
+def _worker_spec(role, **extra):
+    spec = {
+        "preset": "tiny",
+        "init_seed": 0,
+        "model_overrides": {"compute_dtype": "float32"},
+        "engine": {
+            "max_batch": 2, "n_blocks": 24, "block_size": 8,
+            "steps_per_sched": 4, "pipeline_depth": 2,
+            "prefix_cache": True, "kv_checksum": True,
+        },
+        "admission": {"max_queue_depth": 8},
+        "role": role,
+    }
+    spec.update(extra)
+    return spec
+
+
+@pytest.mark.slow
+def test_process_disagg_bit_identity(params):
+    prompts = _shared_prefix_prompts(3, tail=3)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    reps = [
+        RemoteReplica(0, _worker_spec("prefill")),
+        RemoteReplica(1, _worker_spec("decode")),
+    ]
+    router = Router(reps, eject_backoff_s=60.0)
+    with router:
+        assert reps[0].role == "prefill" and reps[1].role == "decode"
+        assert reps[0].kv_capable and reps[1].kv_capable
+        results = []
+        for p in prompts:
+            results.append(router.submit(p, n_new).result(timeout=120))
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+        assert info["replica"] == 1
+    assert router.counters["kv_migrations"] >= 1
+    assert router.counters["kv_pages_migrated"] >= 1
+    assert router.counters["kv_migration_rejects"] == 0
+
+
+@pytest.mark.slow
+def test_process_prefill_kill_mid_leg_falls_back(params):
+    # The prefill worker SIGKILLs itself right after acking its FIRST
+    # wire submit — which is request 0's prefill leg, mid-migration.
+    # Both requests must still finish bit-identically on the decode
+    # tier; the dead prefill tier just means no pages ever cross.
+    prompts = _distinct_prompts(2, seed=3)
+    n_new = 6
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    reps = [
+        RemoteReplica(0, _worker_spec("prefill", kill_after_submits=1)),
+        RemoteReplica(1, _worker_spec("decode")),
+    ]
+    router = Router(reps, eject_backoff_s=60.0)
+    with router:
+        results = []
+        for p in prompts:
+            results.append(router.submit(p, n_new).result(timeout=120))
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+    assert router.counters["kv_pages_migrated"] == 0
+
+
+@pytest.mark.slow
+def test_process_corrupt_kv_migration_over_tcp(params):
+    # Same corruption drill as in-process, but the page crosses a real
+    # socket: the parent-side injector flips the byte as the transfer
+    # leaves, the WORKER's adopt path catches the digest lie.
+    prompts = _distinct_prompts(3)
+    n_new = 8
+    ref = _undisturbed(params, prompts, n_new, **_KV_KW)
+    events = []
+    bus = EventBus()
+    bus.subscribe(lambda ev: events.append(ev))
+    faults = ServingFaultInjector("corrupt_kv_migration@req1:r1", bus=bus)
+    reps = [
+        RemoteReplica(0, _worker_spec("prefill"), bus=bus,
+                      fault_injector=faults),
+        RemoteReplica(1, _worker_spec("decode"), bus=bus,
+                      fault_injector=faults),
+    ]
+    router = Router(reps, bus=bus, eject_backoff_s=60.0)
+    with router:
+        results = []
+        for p in prompts:
+            results.append(router.submit(p, n_new).result(timeout=120))
+    for i, (status, tokens, info) in enumerate(results):
+        assert status == "done", (i, status, info)
+        assert tokens == ref[i], (i, tokens, ref[i])
+    assert router.counters["kv_migrations"] == 3
+    assert router.counters["kv_migration_rejects"] >= 1
+    rejects = [ev for ev in events if ev.get("event") == "kv_migration_reject"]
+    assert rejects and rejects[0]["reason"] == "checksum_mismatch"
