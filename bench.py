@@ -263,7 +263,7 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--fleet-scenario", default="kill",
         choices=[
             "kill", "rolling", "hotprefix", "upgrade", "proc-kill",
-            "partition", "disagg",
+            "partition", "disagg", "decode-sat",
         ],
         help="serving-fleet mode: kill = deterministic replica_crash on "
         "replica 0 one third into the burst (redrive drill); rolling = "
@@ -282,7 +282,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         "prefill legs, the rest only decode, zipf-skewed shared-prefix "
         "traffic migrates KV pages prefill->decode and the record is the "
         "decode tier's TTFT while the prefill tier absorbs the prefill "
-        "burst (kv migration counters recorded)",
+        "burst (kv migration counters recorded); decode-sat = same "
+        "disaggregated tiers but the offered load is 4x --rate-rps so "
+        "the DECODE tier saturates — a live SLO engine (rolling "
+        "percentile sketches per replica) rides the fleet bus and the "
+        "record asserts prefill-tier isolation: the prefill replica's "
+        "latency distribution stays flat while decode queue-wait "
+        "inflates (sketch summaries + fired alerts recorded)",
     )
     parser.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_canary", action="store_true", help=argparse.SUPPRESS)
@@ -908,7 +914,7 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     n_requests = args.n_requests or 4 * max_batch * args.replicas
     pfx_pool = args.prefix_pool_size
     pfx_len = 0
-    if args.fleet_scenario in ("hotprefix", "disagg"):
+    if args.fleet_scenario in ("hotprefix", "disagg", "decode-sat"):
         pfx_pool = pfx_pool or 2 * args.replicas
         block_size = min(block_size, max(8, cfg.context_length // 8))
         pfx_len = args.prefix_len or 2 * block_size
@@ -927,7 +933,10 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     # The disagg scenario is meaningless without a prefix cache (there
     # would be nothing to snapshot) and enables kv_checksum so migrated
     # pages carry + verify their integrity identity, as in production.
-    disagg = args.fleet_scenario == "disagg"
+    # decode-sat reuses the full disagg topology (replica 0 = prefill
+    # tier) and layers a live SLO engine + 4x offered load on top.
+    decode_sat = args.fleet_scenario == "decode-sat"
+    disagg = args.fleet_scenario == "disagg" or decode_sat
 
     def make_engine():
         return ServingEngine(
@@ -937,6 +946,25 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             admit_batch=args.admit_batch,
             prefix_cache=args.prefix_cache or disagg,
             kv_checksum=disagg,
+        )
+
+    # decode-sat: the live SLO engine subscribes to the fleet bus; every
+    # replica-tagged terminal feeds its per-replica rolling sketches. The
+    # window is sized past the whole burst so nothing rotates out and the
+    # tier comparison below covers every request.
+    bus = slo = None
+    if decode_sat:
+        from pretraining_llm_tpu.observability.events import EventBus
+        from pretraining_llm_tpu.observability.slo import (
+            SLOEngine, default_slo_classes,
+        )
+
+        bus = EventBus()
+        slo = SLOEngine(
+            classes=default_slo_classes(
+                ttft_s=args.slo_ttft_s, e2e_s=args.slo_e2e_s
+            ),
+            bus=bus, window_s=600.0,
         )
 
     faults = None
@@ -993,7 +1021,7 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
     else:
         replicas = [
             Replica(
-                i, make_engine, fault_injector=faults,
+                i, make_engine, fault_injector=faults, bus=bus,
                 # disagg: replica 0 is the dedicated prefill tier (no
                 # client traffic), everyone else decodes migrated pages.
                 role=(
@@ -1011,6 +1039,7 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         admission=AdmissionController(
             max_queue_depth=4 * max_batch * args.replicas
         ),
+        bus=bus, slo=slo,
         # For the partition drill the backoff must outlast the scheduled
         # heal: relaunch tears down the blackholed gate, and with it the
         # kernel backlog whose post-heal flush exercises the fence
@@ -1026,7 +1055,11 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         ),
     )
     spec = LoadSpec(
-        n_requests=n_requests, mode="open", rate_rps=args.rate_rps,
+        n_requests=n_requests, mode="open",
+        # decode-sat: offered load deliberately outruns the decode
+        # tier's service rate so its queues build — arrivals stay open
+        # loop, so the backlog shows up as queue-wait, not lower rps.
+        rate_rps=args.rate_rps * (4.0 if decode_sat else 1.0),
         vocab_size=cfg.vocab_size,
         prompt_len_min=max(1, prompt_len // 4), prompt_len_max=prompt_len,
         max_new_min=new_tokens, max_new_max=new_tokens,
@@ -1094,6 +1127,10 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             if getattr(rep, "_c_fenced", None) is not None else 0
             for rep in replicas
         )
+        # Snapshot the live surfaces while the fleet is still up:
+        # fleet_health() polls each replica's health_pull.
+        slo_snap = slo.snapshot() if slo is not None else None
+        fleet_health = router.fleet_health() if decode_sat else None
     finally:
         router.stop()
     s = report.summary()
@@ -1142,11 +1179,11 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
         "wall_s": round(report.wall_s, 2),
         "device": jax.devices()[0].device_kind,
     }
-    if args.fleet_scenario in ("hotprefix", "disagg"):
+    if args.fleet_scenario in ("hotprefix", "disagg", "decode-sat"):
         rec["prefix_pool_size"] = pfx_pool
         rec["prefix_len"] = pfx_len
         rec["prefix_zipf"] = args.prefix_zipf
-    if args.fleet_scenario == "disagg":
+    if disagg:
         # Decode-tier latency under prefill-tier load: every client
         # request is served by a decode replica (the prefill tier takes
         # only migration legs), so the TTFT percentiles above ARE the
@@ -1168,6 +1205,36 @@ def run_serving_fleet_bench(args: argparse.Namespace) -> dict:
             1 for o in report.outcomes
             if o.status == "done" and o.n_tokens > new_tokens
         )
+    if decode_sat and slo_snap is not None:
+        # Tier comparison from the live sketches. Client requests all
+        # terminate on decode replicas; the prefill replica's terminals
+        # are the migration legs — its e2e distribution IS the prefill
+        # tier's service time. Isolation holds when that distribution
+        # stays inside the TTFT objective even though the decode tier's
+        # queue wait has blown past it.
+        lat = slo_snap["latency"]["replicas"]
+        prefill_lat = lat.get("0", {})
+        decode_qw_p99 = max(
+            (
+                s.get("queue_wait_s", {}).get("p99", 0.0)
+                for i, s in lat.items() if i != "0"
+            ),
+            default=0.0,
+        )
+        prefill_e2e_p99 = prefill_lat.get("e2e_s", {}).get("p99")
+        rec["rate_rps_offered"] = spec.rate_rps
+        rec["slo_fleet_ttft"] = slo_snap["latency"]["fleet"]["ttft_s"]
+        rec["prefill_tier_e2e"] = prefill_lat.get("e2e_s", {})
+        rec["prefill_tier_queue"] = prefill_lat.get("queue_wait_s", {})
+        rec["decode_tier_queue_p99_s"] = round(decode_qw_p99, 4)
+        rec["slo_alerts_fired"] = slo_snap["alerts"]["fired_total"]
+        rec["slo_alerts_active"] = len(slo_snap["alerts"]["active"])
+        rec["prefill_isolated"] = bool(
+            prefill_e2e_p99 is not None
+            and prefill_e2e_p99 <= args.slo_ttft_s
+        )
+        if fleet_health is not None:
+            rec["fleet_gauges"] = fleet_health["fleet"].get("gauges", {})
     return rec
 
 

@@ -28,7 +28,20 @@ device work. Endpoints:
   GET  /metrics       Prometheus text exposition: the loop's typed
                       registry (counters/histograms) when wired, plus
                       loop/engine/admission gauges and typed HTTP
-                      counters (``..._total``).
+                      counters (``..._total``);
+  GET  /slo           the live SLO snapshot (observability/slo.py):
+                      rolling-window latency distributions (sketch
+                      percentiles per replica + fleet-wide), per-class
+                      error-budget status and burn rates, active/
+                      recently-resolved alerts — and, behind a fleet
+                      router, the aggregated worker health gauges
+                      (Router.fleet_health). 404 when no SLO engine is
+                      wired (``slo=`` here or a router with one);
+  GET  /metricsz      the same numbers /metrics exposes, as one JSON
+                      object (machine-readable: ``gauges`` is the
+                      loop's counter snapshot, ``series`` the typed
+                      registry snapshot when wired) — for pollers that
+                      want values without parsing Prometheus text.
 
 ``loop`` is anything with the EngineLoop surface — a single EngineLoop or
 a fleet Router (frontend/router.py); the gateway never inspects which.
@@ -99,6 +112,7 @@ class ServingGateway:
         healthz_stale_after_s: float = 0.0,
         retry_jitter_frac: float = 0.25,
         retry_jitter_seed: int = 0,
+        slo: Optional[Any] = None,
     ) -> None:
         if healthz_stale_after_s < 0:
             raise ValueError(
@@ -113,6 +127,10 @@ class ServingGateway:
         self.loop = loop
         self.encode = encode
         self.decode = decode
+        # Live SLO engine for GET /slo on the single-loop path; behind a
+        # fleet router the loop's own slo_snapshot() wins (it folds the
+        # aggregated worker health in).
+        self.slo = slo
         self.default_deadline_s = float(default_deadline_s)
         # 0 disables the staleness 503: a cold-start jit compile can
         # legitimately hold the loop thread for minutes, so the threshold
@@ -295,6 +313,26 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             self.gateway.count_response(200)
+        elif self.path.split("?", 1)[0] == "/slo":
+            gw = self.gateway
+            snap_fn = getattr(gw.loop, "slo_snapshot", None)
+            if snap_fn is not None:
+                self._send_json(200, snap_fn())
+            elif gw.slo is not None:
+                self._send_json(200, gw.slo.snapshot())
+            else:
+                self._send_json(
+                    404, {"error": "no SLO engine configured"}
+                )
+        elif self.path.split("?", 1)[0] == "/metricsz":
+            gw = self.gateway
+            body: Dict[str, Any] = {"gauges": gw.loop.metrics()}
+            registry = getattr(gw.loop, "registry", None)
+            if registry is not None and hasattr(registry, "snapshot"):
+                body["series"] = registry.snapshot()
+            with gw._counters_lock:
+                body["http"] = dict(gw.http_counters)
+            self._send_json(200, body)
         elif self.path.split("?", 1)[0] == "/debug/requests":
             # Live per-request introspection — best-effort reads off the
             # hot path (see EngineLoop.debug_requests); stale by at most

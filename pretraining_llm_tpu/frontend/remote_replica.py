@@ -1504,6 +1504,30 @@ class RemoteReplica:
                 out["failure"] = loop.failure
         return out
 
+    def health_pull(self) -> Dict[str, Any]:
+        """Worker-side gauges + serialized latency sketches for the
+        fleet health snapshot (Router.fleet_health). Doubles as a lease
+        heartbeat like every health poll. A v<4 peer never sees the op:
+        the cached plain-health snapshot is returned instead, flagged
+        ``proto_fallback`` so the aggregate says WHY a replica has no
+        gauge section rather than silently thinning out."""
+        if self._connected() and self._peer_proto >= 4:
+            try:
+                out = dict(
+                    self._rpc(
+                        "health_pull",
+                        {"fence": self.fence, "lease_s": self.lease_s},
+                        timeout=self.rpc_timeout_s,
+                    )
+                )
+                out["proto"] = self._peer_proto
+                return out
+            except Exception:
+                pass  # fall through to the cached snapshot
+        snap = dict(self._snapshot)
+        snap["proto_fallback"] = True
+        return snap
+
     # -- internals ----------------------------------------------------
 
     def _ensure_health_thread(self) -> None:

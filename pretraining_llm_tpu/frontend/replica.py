@@ -409,3 +409,40 @@ class Replica:
             if loop.failure is not None:
                 out["failure"] = repr(loop.failure)
         return out
+
+    def health_pull(self) -> Dict[str, Any]:
+        """Surface parity with RemoteReplica.health_pull: the same gauge
+        shape assembled locally (no wire hop, no sketches — in-process
+        events land on the router's bus directly, so the router-side SLO
+        engine already holds this replica's distributions)."""
+        out = self.debug_snapshot()
+        loop = self.loop
+        if loop is None or not self.alive:
+            out["proto_fallback"] = True
+            return out
+        out["running"] = bool(loop.running)
+        out["fence"] = 0  # in-process replicas are never fenced
+        gauges: Dict[str, Any] = {}
+        eng = self.engine
+        hg = getattr(eng, "health_gauges", None) if eng is not None else None
+        if hg is not None:
+            gauges.update(hg())
+        gauges["active_requests"] = int(loop.active_requests)
+        if loop.admission is not None:
+            adm = loop.admission.snapshot()
+            gauges["admission_depth"] = int(adm.get("live_requests", 0))
+            gauges["admission_outstanding_tokens"] = int(
+                adm.get("outstanding_tokens", 0)
+            )
+        out["gauges"] = gauges
+        try:
+            from pretraining_llm_tpu.observability.device import (
+                DeviceTelemetry,
+            )
+
+            hbm = DeviceTelemetry(bus=None).sample()
+        except Exception:
+            hbm = {}
+        if hbm:
+            out["hbm"] = hbm
+        return out

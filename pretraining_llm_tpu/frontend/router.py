@@ -208,6 +208,7 @@ class Router:
         recover: bool = False,
         kv_migrate_timeout_s: float = 30.0,
         kv_home_max: int = 4096,
+        slo: Any = None,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -250,6 +251,11 @@ class Router:
         self.bus = bus
         self.registry = registry
         self.tracer = tracer
+        # Optional live SLO engine (observability/slo.py). The router
+        # never feeds it — it subscribes to the bus on its own — but
+        # holding the handle here lets the gateway serve GET /slo and
+        # fleet_health() fold worker sketches into the same snapshot.
+        self.slo = slo
         self._clock = clock
         self.affinity_tokens = int(affinity_tokens)
         self.spill_margin = int(spill_margin)
@@ -761,6 +767,18 @@ class Router:
                 # resurrect it.
                 self.journal.append(
                     {"rec": "terminal", "frid": frid, "status": "rejected"}
+                )
+            if self.bus is not None:
+                # Every replica refused (busy, storming, or unavailable):
+                # the client got a 429 the fleet COULD not absorb. The SLO
+                # engine counts this as availability burn — per-replica
+                # refusals that spill to a peer never reach here.
+                self.bus.emit(
+                    "req_rejected", reason="placement", fleet=True,
+                    **(
+                        {"trace_id": trace.trace_id}
+                        if trace is not None else {}
+                    ),
                 )
             # Deferred-finish means no replica loop closed the root on
             # our behalf; the router must, or the tree never terminates.
@@ -1930,4 +1948,70 @@ class Router:
             for rep in self.replicas
             if rep.loop is not None and rep.alive
         }
+        return out
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """One aggregated fleet health snapshot (the GET /slo ``fleet``
+        section): per-replica ``health_pull`` gauges — KV pool
+        occupancy, queue/admission depths, lease/fence generations,
+        KV-migration counters, device HBM watermarks — plus fleet-wide
+        sums, and the worker-side latency sketches merged order-
+        invariantly (sketches.DigestSketch.merge_all) as a cross-check
+        against the bus-fed SLO distributions. In-process replicas
+        answer locally; process/attached workers answer over the wire
+        (proto >= 4), older peers degrade to their cached health
+        snapshot flagged ``proto_fallback``."""
+        replicas: Dict[str, Any] = {}
+        sums: Dict[str, float] = {}
+        worker_sketches: Dict[str, List[Any]] = {}
+        hbm_peak = 0.0
+        active = 0
+        max_fence = 0
+        for rep in self.replicas:
+            pull = getattr(rep, "health_pull", None)
+            snap = pull() if pull is not None else rep.debug_snapshot()
+            replicas[str(rep.index)] = snap
+            if rep.accepting:
+                active += 1
+            max_fence = max(max_fence, int(snap.get("fence") or 0))
+            for key, val in (snap.get("gauges") or {}).items():
+                if isinstance(val, (int, float)):
+                    sums[key] = sums.get(key, 0.0) + val
+            for dev in (snap.get("hbm") or {}).values():
+                hbm_peak = max(hbm_peak, float(dev.get("bytes_in_use", 0.0)))
+            for metric, payload in (snap.get("sketches") or {}).items():
+                worker_sketches.setdefault(metric, []).append(payload)
+        with self._counters_lock:
+            counters = dict(self.counters)
+        fleet: Dict[str, Any] = {
+            "replicas_total": len(self.replicas),
+            "replicas_active": active,
+            "brownout_active": self.brownout_active,
+            "draining": self._draining,
+            "max_fence": max_fence,
+            "gauges": sums,
+            "counters": counters,
+        }
+        if hbm_peak:
+            fleet["hbm_peak_bytes_in_use"] = hbm_peak
+        if worker_sketches:
+            from pretraining_llm_tpu.observability.sketches import (
+                DigestSketch,
+            )
+
+            fleet["worker_sketches"] = {
+                metric: DigestSketch.merge_all(
+                    DigestSketch.from_dict(p) for p in payloads
+                ).summary()
+                for metric, payloads in sorted(worker_sketches.items())
+            }
+        return {"replicas": replicas, "fleet": fleet}
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The GET /slo body behind a fleet router: the SLO engine's
+        distributions/budgets/alerts plus the aggregated fleet health."""
+        out: Dict[str, Any] = (
+            self.slo.snapshot() if self.slo is not None else {}
+        )
+        out["fleet_health"] = self.fleet_health()
         return out

@@ -38,7 +38,16 @@ from typing import Any, Dict, Optional
 #      base64-encoded inside the JSON frame and carry the same ``g``
 #      fence stamp as every other worker frame, so stale-generation
 #      pages are dropped by the existing fence filter
-PROTO_VERSION = 3
+#   4  adds the ``health_pull`` request: like ``health`` (it doubles as
+#      a lease heartbeat + clock sample the same way) but the reply also
+#      carries worker-side gauges — engine row/KV-pool occupancy, queue
+#      depths, KV-migration counters, device HBM watermarks — and the
+#      worker's rolling-window latency sketches serialized via
+#      observability/sketches.py, so the router can aggregate one fleet
+#      health snapshot (GET /slo) without a debug_engine round-trip per
+#      replica. A v<4 peer never sees the op; the router falls back to
+#      the fields the plain health reply already carries.
+PROTO_VERSION = 4
 
 # A frame is one JSON op or one token batch — 64 MiB means a corrupt
 # length prefix fails fast instead of attempting a multi-GB recv.

@@ -30,6 +30,14 @@ submit          lane="replica" -> Replica.submit (state gate + fault
 cancel          EngineLoop.cancel by rid
 drain           Replica.drain() (loop.begin_drain + state)
 health          running/draining/active_requests/last_turn_age_s/...
+health_pull     the health reply PLUS worker-side gauges (engine row/
+                KV-pool occupancy, queue + admission depths, KV-
+                migration counters, stale-frame drops, device HBM
+                watermarks) and the worker's rolling-window latency
+                sketches (observability/sketches.py, serialized) — the
+                router's fleet health snapshot aggregates these. Doubles
+                as a lease heartbeat exactly like ``health``. proto >= 4
+                peers only (the parent gates sends).
 metrics         EngineLoop.metrics() snapshot
 debug_requests  EngineLoop.debug_requests()
 debug_engine    EngineLoop.debug_engine()
@@ -110,6 +118,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..observability.sketches import WindowedSketch
+from ..observability.slo import LATENCY_METRICS, TERMINAL_KINDS
 from ..observability.spans import SpanRecorder
 from ..observability.tracing import Tracer
 from .wire import (
@@ -255,6 +265,17 @@ class WorkerServer:
         # dead connection must never complete against a new sender.
         self._kv_rx: Dict[Any, list] = {}
         self._kv_stale_frames = 0
+        # Worker-local rolling latency sketches, fed off the SAME event
+        # stream this worker forwards to the router (send_event). The
+        # router's SLO engine sketches the forwarded events too; these
+        # local copies are the worker's own ground truth, shipped inside
+        # health_pull replies so a router that attached mid-run (or
+        # missed forwards across a partition) still aggregates a
+        # complete fleet view.
+        self._lat_sketches: Dict[str, WindowedSketch] = {
+            m: WindowedSketch(window_s=60.0, buckets=6)
+            for m in LATENCY_METRICS
+        }
         self._fence = 0
         self._lease_s = 0.0
         self._last_contact = time.monotonic()
@@ -408,6 +429,11 @@ class WorkerServer:
                 pass  # reader side notices and tears the connection down
 
     def send_event(self, kind: str, step: int, fields: Dict[str, Any]) -> None:
+        if kind in TERMINAL_KINDS:
+            for metric in LATENCY_METRICS:
+                val = fields.get(metric)
+                if isinstance(val, (int, float)):
+                    self._lat_sketches[metric].observe(float(val))
         frame = {
             "op": "event", "kind": kind, "step": step, "fields": fields,
             "g": self._fence,
@@ -565,6 +591,12 @@ class WorkerServer:
             # the router's current fence generation + lease term.
             self._adopt_lease(req)
             self._send({"id": rid, "ok": self._health()})
+            return True
+        if op == "health_pull":
+            # Heartbeat semantics identical to health; the reply adds
+            # the gauge + sketch payload the fleet snapshot aggregates.
+            self._adopt_lease(req)
+            self._send({"id": rid, "ok": self._health_pull()})
             return True
         if op == "metrics":
             self._send({"id": rid, "ok": loop.metrics()})
@@ -874,6 +906,42 @@ class WorkerServer:
             # parent's offset estimator tracks drift continuously.
             "clock": time.perf_counter(),
         }
+
+    def _health_pull(self) -> Dict[str, Any]:
+        """health fields + worker gauges + serialized latency sketches
+        (proto >= 4 reply body; see the op table in the module doc)."""
+        out = self._health()
+        loop = self.replica.loop
+        eng = loop.engine
+        gauges: Dict[str, Any] = {}
+        hg = getattr(eng, "health_gauges", None)
+        if hg is not None:
+            gauges.update(hg())
+        gauges["active_requests"] = int(loop.active_requests)
+        if loop.admission is not None:
+            adm = loop.admission.snapshot()
+            gauges["admission_depth"] = int(adm.get("live_requests", 0))
+            gauges["admission_outstanding_tokens"] = int(
+                adm.get("outstanding_tokens", 0)
+            )
+        gauges["kv_stale_frames"] = int(self._kv_stale_frames)
+        out["gauges"] = gauges
+        # Device HBM watermarks: a host-side allocator query, never a
+        # device sync; CPU and API-less backends report {} and the
+        # snapshot simply has no hbm section for this replica.
+        try:
+            from ..observability.device import DeviceTelemetry
+
+            hbm = DeviceTelemetry(bus=None).sample()
+        except Exception:
+            hbm = {}
+        if hbm:
+            out["hbm"] = hbm
+        out["sketches"] = {
+            m: ws.merged().to_dict()
+            for m, ws in self._lat_sketches.items()
+        }
+        return out
 
     def _exit_clean(self) -> None:
         try:

@@ -481,6 +481,33 @@ class ServingEngine:
             )
         return info
 
+    def health_gauges(self) -> Dict[str, Any]:
+        """Point-in-time engine occupancy for the fleet health surface
+        (worker ``health_pull`` replies and Router.fleet_health): row and
+        KV-pool occupancy, queue depth, and the KV-migration counters.
+        Host containers only — mutated between scheduler turns, each
+        read an atomic snapshot — so gateway/worker threads may call it
+        while the engine thread runs, at worst one turn stale. Block 0
+        is reserved scratch, hence the ``- 1`` (same accounting as
+        EngineLoop.debug_engine; the CI gate ties them out)."""
+        pool_total = self.alloc.n_blocks - 1
+        pool_free = self.alloc.available
+        cache = self.prefix_cache
+        pool_cold = cache.evictable if cache is not None else 0
+        stats = dict(self.stats)
+        return {
+            "rows_active": sum(r is not None for r in list(self.rows)),
+            "rows_capacity": self.max_batch,
+            "waiting": len(self.waiting),
+            "pool_total": pool_total,
+            "pool_free": pool_free,
+            "pool_cold": pool_cold,
+            "pool_live": pool_total - pool_free - pool_cold,
+            "kv_pages_adopted": int(stats.get("kv_pages_adopted", 0)),
+            "kv_pages_rejected": int(stats.get("kv_pages_rejected", 0)),
+            "preemptions": int(stats.get("preemptions", 0)),
+        }
+
     def validate_request(
         self, prompt_ids: Sequence[int], max_new_tokens: Any
     ) -> int:

@@ -73,6 +73,13 @@ DECISION_KINDS = (
     # page costs only a re-prefill, never a wrong token.
     "kv_migrate",           # prefill-tier pages pushed to a decode worker
     "kv_migration_reject",  # decode worker refused migrated pages (checksum/capacity/fence)
+
+    # Live SLO engine (observability/slo.py): a fired burn-rate alert is
+    # a decision — it is what an autoscaler or operator acts on — and
+    # the record's alert_id joins it to the slo_alert event pair that
+    # brackets the incident (trace_id, when present, is the request
+    # that tipped the burn over threshold).
+    "slo_alert",          # burn-rate alert fired: alert_id, slo_class, rule
 )
 
 

@@ -111,6 +111,14 @@ EVENT_KINDS = (
     "integrity_kv_mismatch",     # cached KV page failed verify-on-acquire: block
     "integrity_weight_mismatch", # live weight fingerprint drifted: replica
     "integrity_invalid_token",   # out-of-vocab token id reached reap: rid, token
+    # Live SLO engine (observability/slo.py). One record per alert
+    # transition: state="firing" carries alert_id + burn rates over the
+    # rule's short/long windows (and trigger_trace_id when the tipping
+    # request was traced — the join to the req_* stream); the matching
+    # state="resolved" record reuses the SAME alert_id, so the pair
+    # brackets the incident in the replayable timeline. Every firing
+    # also lands an ``slo_alert`` decision with the same alert_id.
+    "slo_alert",      # burn-rate alert transition: alert_id, state, rule
 )
 
 

@@ -1429,3 +1429,104 @@ grep -q "lost=0" "$OBS_TMP/disagg_report.out" || {
     echo "obs_report --fleet (disagg) did not report lost=0"; exit 1; }
 grep -q "kv migration" "$OBS_TMP/disagg_report.out" || {
     echo "obs_report --fleet missing the kv migration section"; exit 1; }
+
+# Live SLO gate: boot a 2-replica fleet with the SLO engine attached,
+# serve a healthy batch over real HTTP, then poll GET /slo — the snapshot
+# must be well-formed (distributions, budgets, fleet health) with ZERO
+# alerts on a clean run, and obs_report --live must reconcile the live
+# sketch quantiles against the exact offline percentiles computed from
+# the same run's event stream.
+JAX_PLATFORMS=cpu python - "$OBS_TMP" <<'EOF'
+import dataclasses, json, subprocess, sys, threading, urllib.request
+import jax
+import numpy as np
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.capacity import DecisionLog
+from pretraining_llm_tpu.observability.events import EventBus
+from pretraining_llm_tpu.observability.slo import (
+    SLOEngine, default_slo_classes,
+)
+
+tmp = sys.argv[1]
+cfg = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+params = transformer.init_params(cfg, jax.random.key(0))
+events_path = f"{tmp}/slo_events.jsonl"
+bus = EventBus(events_path)
+# Generous objectives (this is a structural gate, not a perf bet) and a
+# window wide enough that nothing rotates out before reconciliation.
+slo = SLOEngine(
+    classes=default_slo_classes(ttft_s=120.0, e2e_s=600.0),
+    bus=bus, decisions=DecisionLog(bus=bus), window_s=600.0,
+)
+
+def factory():
+    return ServingEngine(
+        params, cfg, temperature=0.0, max_batch=2, n_blocks=24,
+        block_size=8, steps_per_sched=4, pipeline_depth=2,
+    )
+
+replicas = [Replica(i, factory, bus=bus) for i in range(2)]
+router = Router(replicas, bus=bus, slo=slo, eject_backoff_s=0.1)
+router.start()
+gw = ServingGateway(router, port=0, slo=slo)
+gw.start()
+base = f"http://127.0.0.1:{gw.port}"
+
+rng = np.random.default_rng(0)
+lengths = (5, 9, 14, 7, 11, 3, 16, 6) * 3  # 24 requests: >= the 20 the
+# reconciliation needs before it checks quantiles instead of skipping
+prompts = [
+    rng.integers(0, cfg.vocab_size, size=int(n)).tolist() for n in lengths
+]
+outs = {}
+
+def post(i, p):
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"prompt": p, "max_new_tokens": 8}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        outs[i] = json.loads(r.read())
+
+threads = [threading.Thread(target=post, args=(i, p))
+           for i, p in enumerate(prompts)]
+for t in threads: t.start()
+for t in threads: t.join(timeout=600)
+assert not any(t.is_alive() for t in threads), "an SLO-gate request hung"
+assert all(outs[i]["status"] == "done" for i in range(len(prompts))), outs
+
+with urllib.request.urlopen(base + "/slo", timeout=30) as r:
+    snap = json.loads(r.read())
+# Well-formed: distributions + budgets + alerts + aggregated fleet health.
+assert snap["alerts"]["active"] == [], snap["alerts"]
+assert snap["alerts"]["fired_total"] == 0, snap["alerts"]
+fleet = snap["latency"]["fleet"]
+assert fleet["e2e_s"]["count"] == len(prompts), fleet
+assert fleet["ttft_s"]["p99"] > 0
+cls = snap["classes"]["interactive"]
+assert cls["events"] == len(prompts) and cls["bad"] == 0, cls
+fh = snap["fleet_health"]["fleet"]
+assert fh["replicas_total"] == 2 and fh["replicas_active"] == 2, fh
+assert fh["gauges"]["rows_capacity"] == 4.0, fh["gauges"]
+
+with urllib.request.urlopen(base + "/metricsz", timeout=30) as r:
+    mz = json.loads(r.read())
+assert "gauges" in mz and "http" in mz, list(mz)
+
+# The analyzer's --live fetch against the SAME gateway + event stream:
+# sketch quantiles must land inside the exact offline rank bands.
+rc = subprocess.run(
+    [sys.executable, "scripts/obs_report.py", "--strict",
+     "--live", base, events_path],
+).returncode
+assert rc == 0, f"obs_report --live --strict failed (rc={rc})"
+
+gw.stop(); router.stop(); bus.close()
+print(f"live SLO smoke ok: {len(prompts)} requests, 0 alerts, "
+      f"ttft_p99={fleet['ttft_s']['p99']:.3f}s, live reconciled")
+EOF

@@ -65,6 +65,16 @@ fails unalignable spans, orphaned attempts or subtrees, and worker spans
 outside their attempt's window beyond the recorded clock error bound,
 which is the CI cross-host tracing gate.
 
+``--live URL`` adds the ONLINE view: polls a running gateway's
+``GET /slo`` (scripts/serve.py --http) for the rolling-window latency
+sketches, per-class error budgets, burn rates, active alerts, and the
+router's fleet-health snapshot. Given events JSONL paths too, the live
+sketch percentiles are reconciled against exact percentiles computed
+offline from the same run's terminal events — each live quantile must
+land inside the exact-rank band ``[q-eps, q+eps]`` (the sketch's
+accuracy contract). ``--strict`` makes a mismatch fatal, which is the
+CI live-SLO gate.
+
 Deliberately jax-free: imports only the stdlib + the observability package
 (itself stdlib-only at import), so it runs where the training stack doesn't.
 """
@@ -73,6 +83,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
@@ -1833,6 +1844,160 @@ def print_report(report: Dict[str, Any]) -> None:
             print(f"  +{entry['t_rel_s']:9.3f}s {entry['event']:<13} {extra}")
 
 
+# ---------------------------------------------------------------------------
+# live view: poll a running gateway's GET /slo, reconcile with offline events
+# ---------------------------------------------------------------------------
+
+LIVE_METRICS = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
+_LIVE_TERMINALS = ("req_done", "req_expired", "req_error", "req_cancelled")
+_LIVE_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def fetch_live(url: str, timeout_s: float = 5.0) -> Dict[str, Any]:
+    """GET <url>/slo from a running scripts/serve.py gateway (stdlib only)."""
+    import urllib.request
+
+    target = url.rstrip("/")
+    if not target.endswith("/slo"):
+        target += "/slo"
+    with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def build_live_report(
+    snap: Dict[str, Any],
+    events: List[Dict[str, Any]],
+    rank_eps: float = 0.05,
+) -> Dict[str, Any]:
+    """Fold a GET /slo snapshot; reconcile sketches vs offline events.
+
+    The live figures come from fixed-size mergeable sketches over a
+    ROLLING window; the offline figures are exact percentiles over the
+    full events JSONL. When the run fits inside the live window (the CI
+    smoke case) the two must agree within the sketch's rank-error bound:
+    each live quantile must land between the exact values at ranks
+    q-rank_eps and q+rank_eps. A live window that saw far fewer events
+    than the file (a long run, window already rotated) is reported as
+    ``window_truncated`` and skipped rather than failed — the contract
+    is accuracy, not that a 60s window summarizes an hour.
+    """
+    fleet = snap.get("latency", {}).get("fleet", {})
+    problems: List[str] = []
+    reconcile: Dict[str, Any] = {}
+    offline: Dict[str, List[float]] = {m: [] for m in LIVE_METRICS}
+    for ev in events:
+        if ev.get("event") not in _LIVE_TERMINALS:
+            continue
+        for m in LIVE_METRICS:
+            v = ev.get(m)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                offline[m].append(float(v))
+    for m in LIVE_METRICS:
+        live = fleet.get(m, {})
+        live_n = int(live.get("count", 0))
+        vals = sorted(offline[m])
+        row: Dict[str, Any] = {
+            "live_count": live_n,
+            "offline_count": len(vals),
+            "checked": False,
+        }
+        if len(vals) >= 20 and live_n > 0:
+            if live_n < len(vals) // 2:
+                row["window_truncated"] = True
+            else:
+                row["checked"] = True
+                for key, q in _LIVE_QUANTILES:
+                    got = live.get(key)
+                    if not isinstance(got, (int, float)):
+                        continue
+                    lo = _percentile(vals, max(0.0, q - rank_eps))
+                    hi = _percentile(vals, min(1.0, q + rank_eps))
+                    slack = 1e-9 + 0.01 * max(abs(lo), abs(hi))
+                    ok = (lo - slack) <= got <= (hi + slack)
+                    row[key] = {
+                        "live": got, "exact_lo": lo, "exact_hi": hi,
+                        "ok": ok,
+                    }
+                    if not ok:
+                        problems.append(
+                            f"live {m} {key}={got:.6g} outside exact "
+                            f"rank band [{lo:.6g}, {hi:.6g}] "
+                            f"(rank_eps={rank_eps})"
+                        )
+        reconcile[m] = row
+    alerts = snap.get("alerts", {})
+    return {
+        "events_seen": snap.get("events_seen", 0),
+        "window_s": snap.get("window_s"),
+        "fleet": fleet,
+        "classes": snap.get("classes", {}),
+        "alerts_active": alerts.get("active", []),
+        "alerts_fired_total": alerts.get("fired_total", 0),
+        "fleet_health": snap.get("fleet_health", {}).get("fleet", {}),
+        "reconcile": reconcile,
+        "problems": problems,
+    }
+
+
+def print_live_report(rep: Dict[str, Any]) -> None:
+    print("== live SLO ==")
+    print(
+        f"events_seen={rep['events_seen']} "
+        f"window_s={rep['window_s']} "
+        f"alerts_active={len(rep['alerts_active'])} "
+        f"alerts_fired_total={rep['alerts_fired_total']}"
+    )
+    for m in LIVE_METRICS:
+        s = rep["fleet"].get(m, {})
+        if not s.get("count"):
+            print(f"  {m:<13} (no samples in window)")
+            continue
+        print(
+            f"  {m:<13} n={s['count']:<6} p50={s.get('p50', 0.0):.4f}s "
+            f"p90={s.get('p90', 0.0):.4f}s p99={s.get('p99', 0.0):.4f}s"
+        )
+    for name, cls in rep["classes"].items():
+        burn = ", ".join(
+            f"{r}={b['short']:.2f}/{b['long']:.2f}"
+            f"{' FIRING' if b.get('firing') else ''}"
+            for r, b in cls.get("burn", {}).items()
+        )
+        print(
+            f"  class {name}: target={cls['target']} "
+            f"events={cls['events']} bad={cls['bad']} "
+            f"budget_spent={cls['budget_spent_frac']:.1%} [{burn}]"
+        )
+    for alert in rep["alerts_active"]:
+        print(
+            f"  ALERT {alert.get('alert_id')} {alert.get('slo_class')}/"
+            f"{alert.get('rule')} severity={alert.get('severity')} "
+            f"burn={alert.get('burn_short'):.1f}/{alert.get('burn_long'):.1f}"
+        )
+    fh = rep.get("fleet_health") or {}
+    if fh:
+        print(
+            f"  fleet: replicas={fh.get('replicas_active')}/"
+            f"{fh.get('replicas_total')} max_fence={fh.get('max_fence')} "
+            f"gauges={fh.get('gauges', {})}"
+        )
+    checked = [m for m, r in rep["reconcile"].items() if r["checked"]]
+    if checked:
+        print("== live vs offline ==")
+        for m in checked:
+            row = rep["reconcile"][m]
+            for key, _ in _LIVE_QUANTILES:
+                c = row.get(key)
+                if c is None:
+                    continue
+                mark = "ok" if c["ok"] else "MISMATCH"
+                print(
+                    f"  {m} {key}: live={c['live']:.4f} in "
+                    f"[{c['exact_lo']:.4f}, {c['exact_hi']:.4f}] {mark}"
+                )
+    for p in rep["problems"]:
+        print(f"  !! {p}")
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     parser.add_argument("paths", nargs="*", help="metrics/events JSONL files")
@@ -1894,6 +2059,23 @@ def main() -> int:
         "--strict makes an undetected corruption, an unanswered probe "
         "divergence, or a broken trace join fatal",
     )
+    parser.add_argument(
+        "--live", default="",
+        help="poll a RUNNING gateway's live SLO engine (base URL, e.g. "
+        "http://localhost:8000): rolling-window percentile sketches, "
+        "error budgets, burn rates, active alerts, fleet health. With "
+        "events JSONL paths, reconciles the live sketch percentiles "
+        "against exact offline percentiles within the sketch's rank "
+        "error bound; --strict makes a mismatch fatal",
+    )
+    parser.add_argument(
+        "--live_timeout_s", type=float, default=5.0,
+        help="HTTP timeout for --live",
+    )
+    parser.add_argument(
+        "--live_rank_eps", type=float, default=0.05,
+        help="rank tolerance for the --live vs offline reconciliation",
+    )
     args = parser.parse_args()
     if args.slo and not args.trace:
         parser.error("--slo needs --trace")
@@ -1905,8 +2087,10 @@ def main() -> int:
         parser.error("--fleet needs events JSONL paths")
     if args.integrity and not args.paths:
         parser.error("--integrity needs events JSONL paths")
-    if not args.paths and not args.trace:
-        parser.error("nothing to analyze: pass JSONL paths and/or --trace")
+    if not args.paths and not args.trace and not args.live:
+        parser.error(
+            "nothing to analyze: pass JSONL paths, --trace, and/or --live"
+        )
 
     records: List[Dict[str, Any]] = []
     bad = 0
@@ -1943,6 +2127,14 @@ def main() -> int:
         events, _ = split_records(records)
         integrity_report = build_integrity_report(events)
         report["integrity"] = integrity_report
+    live_report: Optional[Dict[str, Any]] = None
+    if args.live:
+        snap = fetch_live(args.live, timeout_s=args.live_timeout_s)
+        events, _ = split_records(records)
+        live_report = build_live_report(
+            snap, events, rank_eps=args.live_rank_eps
+        )
+        report["live"] = live_report
     if args.json:
         print(json.dumps(report, indent=2, allow_nan=False))
     else:
@@ -1958,6 +2150,8 @@ def main() -> int:
             print_fleet_report(fleet_report)
         if integrity_report is not None:
             print_integrity_report(integrity_report)
+        if live_report is not None:
+            print_live_report(live_report)
         if bad:
             print(f"!! {bad} unparseable line(s)", file=sys.stderr)
         if slo_report is not None and slo_report["dropped_spans"]:
@@ -1988,6 +2182,10 @@ def main() -> int:
         return 1
     if args.strict and integrity_report is not None and integrity_report["problems"]:
         for p in integrity_report["problems"]:
+            print(f"STRICT: {p}", file=sys.stderr)
+        return 1
+    if args.strict and live_report is not None and live_report["problems"]:
+        for p in live_report["problems"]:
             print(f"STRICT: {p}", file=sys.stderr)
         return 1
     return 0

@@ -218,6 +218,21 @@ def main() -> None:
                         "recompute period; the sentinel quarantines on "
                         "drift from the value pinned at launch; 0 = off "
                         "(default: config)")
+    parser.add_argument("--no-slo", action="store_true",
+                        help="(--http) disable the live SLO engine "
+                        "(GET /slo returns 404, no burn-rate alerts)")
+    parser.add_argument("--slo_ttft_s", type=float, default=2.0,
+                        help="(--http) TTFT latency objective threshold "
+                        "for the 'interactive' SLO class")
+    parser.add_argument("--slo_e2e_s", type=float, default=30.0,
+                        help="(--http) end-to-end latency objective "
+                        "threshold for the 'interactive' SLO class")
+    parser.add_argument("--slo_target", type=float, default=0.99,
+                        help="(--http) success-fraction target shared by "
+                        "the SLO objectives (error budget = 1 - target)")
+    parser.add_argument("--slo_window_s", type=float, default=60.0,
+                        help="(--http) rolling window for the live "
+                        "latency percentile sketches")
     args = parser.parse_args()
     if not args.http and not args.input_file:
         parser.error("--input_file is required unless --http is set")
@@ -344,8 +359,12 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     from pretraining_llm_tpu.frontend.gateway import ServingGateway
     from pretraining_llm_tpu.frontend.replica import Replica
     from pretraining_llm_tpu.frontend.router import Router
+    from pretraining_llm_tpu.observability.capacity import DecisionLog
     from pretraining_llm_tpu.observability.events import EventBus
     from pretraining_llm_tpu.observability.metrics import MetricsRegistry
+    from pretraining_llm_tpu.observability.slo import (
+        SLOEngine, default_slo_classes,
+    )
     from pretraining_llm_tpu.observability.spans import get_recorder
     from pretraining_llm_tpu.observability.tracing import Tracer
     from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
@@ -355,7 +374,22 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
     def pick(cli_val, cfg_val):
         return cfg_val if cli_val is None else cli_val
 
-    bus = EventBus(jsonl_path=args.events) if args.events else None
+    # The SLO engine is a pure bus subscriber, so enabling it forces a
+    # bus into existence even without --events (in-memory, no JSONL).
+    bus = None
+    if args.events or not args.no_slo:
+        bus = EventBus(jsonl_path=args.events)
+    slo = None
+    if not args.no_slo:
+        slo = SLOEngine(
+            classes=default_slo_classes(
+                ttft_s=args.slo_ttft_s, e2e_s=args.slo_e2e_s,
+                target=args.slo_target,
+            ),
+            bus=bus,
+            decisions=DecisionLog(bus=bus),
+            window_s=args.slo_window_s,
+        )
     trace_path = pick(args.trace, fc.trace_path)
     trace_sample = pick(args.trace_sample, fc.trace_sample)
     if args.trace is not None and args.trace_sample is None:
@@ -433,7 +467,7 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
         return Router(
             replicas,
             admission=make_admission(registry, scope="fleet"),
-            bus=bus, registry=registry, tracer=tracer,
+            bus=bus, registry=registry, tracer=tracer, slo=slo,
             affinity_tokens=fc.affinity_tokens,
             spill_margin=fc.spill_margin,
             wedged_after_s=pick(args.wedged_after_s, fc.wedged_after_s),
@@ -579,13 +613,15 @@ def _serve_http(args, cfg, make_engine, enc) -> None:
         ),
         retry_jitter_frac=fc.retry_jitter_frac,
         retry_jitter_seed=fc.retry_jitter_seed,
+        slo=slo,
     )
     fleet = f" ({n_replicas} replicas)" if n_replicas > 1 else ""
     print(
         f"[serve] gateway{fleet} listening on "
         f"http://{gateway._server.server_address[0]}"
         f":{gateway.port} — POST /v1/generate, GET /healthz, GET /readyz, "
-        f"GET /metrics, GET /debug/requests, GET /debug/engine",
+        f"GET /metrics, GET /slo, GET /metricsz, GET /debug/requests, "
+        f"GET /debug/engine",
         file=sys.stderr,
     )
     # SIGTERM (a plain `kill`, the orchestrator's stop signal) must take

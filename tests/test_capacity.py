@@ -117,6 +117,8 @@ def test_capacity_event_kinds_documented():
         "fleet_drain", "upgrade_refused",
         # disaggregated prefill/decode tiers (frontend/router.py)
         "kv_migrate", "kv_migration_reject",
+        # live SLO engine (observability/slo.py)
+        "slo_alert",
     }
 
 

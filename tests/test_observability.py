@@ -9,18 +9,40 @@ Trainer run emits a coherent stream — with no device→host syncs between log
 boundaries on the hot path.
 """
 
+import bisect
 import dataclasses
+import importlib.util
+import itertools
 import json
 import os
 import random
 import subprocess
 import sys
 import threading
+import time
+import urllib.request
 
+import jax
 import pytest
 
 from pretraining_llm_tpu.config import ObservabilityConfig, get_preset
+from pretraining_llm_tpu.frontend.admission import RejectedBusy
+from pretraining_llm_tpu.frontend.gateway import ServingGateway
+from pretraining_llm_tpu.frontend.replica import Replica
+from pretraining_llm_tpu.frontend.router import Router
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+from pretraining_llm_tpu.observability.capacity import DecisionLog
 from pretraining_llm_tpu.observability.events import EventBus, json_line, sanitize_record
+from pretraining_llm_tpu.observability.sketches import (
+    DigestSketch,
+    WindowedCounts,
+    WindowedSketch,
+)
+from pretraining_llm_tpu.observability.slo import (
+    SLOEngine,
+    default_slo_classes,
+)
 from pretraining_llm_tpu.observability.goodput import CATEGORIES, GoodputAccountant
 from pretraining_llm_tpu.observability.metrics import (
     DEFAULT_LATENCY_BUCKETS,
@@ -42,6 +64,7 @@ from pretraining_llm_tpu.observability.tracing import (
 )
 from pretraining_llm_tpu.observability.device import CompileWatcher
 from pretraining_llm_tpu.observability.hub import ObservabilityHub
+from pretraining_llm_tpu.resilience.faults import ServingFaultInjector
 from pretraining_llm_tpu.training.metrics import MetricsLogger, Throughput
 from pretraining_llm_tpu.training.trainer import Trainer
 from pretraining_llm_tpu.utils.profiling import StepProfiler
@@ -1116,3 +1139,506 @@ def test_hub_timed_event_attaches_fields():
         with hub.timed_event("eval", step=5):
             raise RuntimeError("eval died")
     assert seen[-1]["step"] == 5
+
+
+# ------------------------------------------------ streaming sketches (SLO)
+
+
+def _rank_error(sorted_vals, value, q):
+    """Distance (in rank space) from q to the nearest rank that maps to
+    ``value`` in the exact data — 0 when the estimate is exactly right."""
+    lo = bisect.bisect_left(sorted_vals, value) / len(sorted_vals)
+    hi = bisect.bisect_right(sorted_vals, value) / len(sorted_vals)
+    if lo <= q <= hi:
+        return 0.0
+    return min(abs(q - lo), abs(q - hi))
+
+
+_DISTS = {
+    "uniform": lambda rng: rng.random(),
+    "normal": lambda rng: rng.gauss(0.0, 1.0),
+    "lognormal": lambda rng: rng.lognormvariate(0.0, 1.5),
+}
+
+
+@pytest.mark.parametrize("dist", sorted(_DISTS))
+def test_digest_sketch_rank_error_bound(dist):
+    """The documented accuracy contract: rank error at q stays under
+    2*q*(1-q)/compression (plus one sample of slack) on synthetic
+    streams, including a heavy-tailed one."""
+    rng = random.Random(7)
+    vals = [_DISTS[dist](rng) for _ in range(20000)]
+    sk = DigestSketch(compression=64)
+    for v in vals:
+        sk.observe(v)
+    sv = sorted(vals)
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999):
+        bound = 2.0 * q * (1.0 - q) / 64 + 1.0 / len(vals)
+        err = _rank_error(sv, sk.quantile(q), q)
+        assert err <= bound, f"q={q}: rank error {err} > bound {bound}"
+    # Tails clamp to the exact observed extremes; mean is exact.
+    assert sk.quantile(0.0) == min(vals)
+    assert sk.quantile(1.0) == max(vals)
+    exact_mean = sum(vals) / len(vals)
+    assert abs(sk.mean - exact_mean) <= 1e-6 * max(1.0, abs(exact_mean))
+    # Bounded size: the weight cap floors at 1 so the tails keep
+    # singletons, but the centroid count stays O(compression), not O(N).
+    assert len(sk.centroids()) <= 8 * 64
+
+
+def test_digest_sketch_merge_order_invariance():
+    """merge_all flattens + compresses ONCE, so every permutation of the
+    replica sketches yields byte-identical centroids — the property that
+    makes the fleet-wide digest well-defined."""
+    rng = random.Random(3)
+    vals = [rng.lognormvariate(0.0, 1.5) for _ in range(8000)]
+    parts = [DigestSketch(compression=64) for _ in range(5)]
+    for i, v in enumerate(vals):
+        parts[i % 5].observe(v)
+    merges = [
+        DigestSketch.merge_all(p) for p in itertools.permutations(parts)
+    ]
+    ref = merges[0].centroids()
+    for m in merges[1:]:
+        assert m.centroids() == ref
+    # The merged digest keeps the accuracy contract vs the union stream.
+    sv = sorted(vals)
+    for q in (0.5, 0.9, 0.99):
+        bound = 2.0 * q * (1.0 - q) / 64 + 1.0 / len(vals)
+        assert _rank_error(sv, merges[0].quantile(q), q) <= bound
+    assert merges[0].count == len(vals)
+
+
+def test_digest_sketch_wire_roundtrip():
+    rng = random.Random(11)
+    sk = DigestSketch(compression=32)
+    for _ in range(5000):
+        sk.observe(rng.gauss(5.0, 2.0))
+    wire = json.loads(json.dumps(sk.to_dict()))  # actual JSON round-trip
+    back = DigestSketch.from_dict(wire)
+    assert back.centroids() == sk.centroids()
+    assert back.count == sk.count
+    assert (back.min, back.max) == (sk.min, sk.max)
+    for q in (0.1, 0.5, 0.99):
+        assert back.quantile(q) == sk.quantile(q)
+    # Empty sketch round-trips too (a worker that saw no traffic yet).
+    empty = DigestSketch.from_dict(json.loads(json.dumps(
+        DigestSketch().to_dict()
+    )))
+    assert empty.count == 0
+    assert empty.summary() == {"count": 0}
+
+
+def test_windowed_sketch_rotation_under_fake_clock():
+    t = [0.0]
+    ws = WindowedSketch(window_s=6.0, buckets=3, clock=lambda: t[0])
+    for _ in range(10):
+        ws.observe(100.0)
+    assert ws.count == 10
+    t[0] = 3.0
+    ws.observe(1.0)
+    assert ws.count == 11  # both buckets still inside the window
+    # Advance past the window: the old bucket falls off wholesale, the
+    # lifetime total survives.
+    t[0] = 7.9
+    assert ws.count == 1
+    assert ws.quantile(0.5) == 1.0
+    t[0] = 100.0
+    assert ws.count == 0
+    assert ws.total_count == 11
+    assert ws.summary()["count"] == 0
+
+
+def test_windowed_counts_trailing_sums():
+    t = [0.0]
+    wc = WindowedCounts(horizon_s=40.0, bucket_s=1.0, clock=lambda: t[0])
+    wc.add("events")
+    wc.add("bad")
+    t[0] = 10.0
+    for _ in range(4):
+        wc.add("events")
+    # Trailing 5s sees only the recent burst; 40s sees everything.
+    assert wc.sums(5.0) == {"events": 4.0}
+    assert wc.sums(40.0) == {"events": 5.0, "bad": 1.0}
+    # Past the horizon the old bucket is pruned on the next write, but
+    # lifetime totals keep the full ledger.
+    t[0] = 60.0
+    wc.add("events")
+    assert wc.sums(40.0) == {"events": 1.0}
+    assert wc.totals == {"events": 6.0, "bad": 1.0}
+
+
+# ------------------------------------------------------- live SLO engine
+
+
+def _mk_slo(clock, **kw):
+    bus = EventBus(clock=clock, wall=clock)
+    dec = DecisionLog(bus=bus)
+    kw.setdefault("window_scale", 0.01)
+    slo = SLOEngine(
+        classes=default_slo_classes(
+            ttft_s=kw.pop("ttft_s", 0.5),
+            e2e_s=kw.pop("e2e_s", 2.0),
+            target=kw.pop("target", 0.99),
+        ),
+        bus=bus, decisions=dec, clock=clock, **kw,
+    )
+    alerts = []
+    bus.subscribe(
+        lambda r: alerts.append(r) if r.get("event") == "slo_alert" else None
+    )
+    return bus, dec, slo, alerts
+
+
+def test_slo_fast_burn_fires_and_resolves_with_lineage():
+    """Deterministic alert edge under a fake clock: a healthy prelude
+    stays silent, a burst of slow requests trips fast_burn at the exact
+    event where the burn crosses threshold on both windows, the firing
+    event / decision record / resolved event share one alert_id, and
+    rolling past the short window resolves without new traffic."""
+    t = [100.0]
+    bus, dec, slo, alerts = _mk_slo(lambda: t[0])
+    for i in range(20):
+        t[0] += 0.001
+        bus.emit(
+            "req_done", replica=0, trace_id=f"ok{i}",
+            ttft_s=0.01, tpot_s=0.005, e2e_s=0.1, queue_wait_s=0.0,
+        )
+    assert alerts == []  # clean traffic never pages
+    for i in range(5):
+        t[0] += 0.001
+        bus.emit(
+            "req_done", replica=1, trace_id=f"slow{i}",
+            ttft_s=5.0, tpot_s=0.1, e2e_s=6.0, queue_wait_s=0.5,
+        )
+    firing = [a for a in alerts if a["state"] == "firing"]
+    fast = [a for a in firing if a["rule"] == "fast_burn"]
+    assert len(fast) == 1
+    al = fast[0]
+    # burn = bad_frac / budget: fires at the 4th slow event, where
+    # 4/24 bad over a 0.01 budget first clears the 14x threshold.
+    assert al["slo_class"] == "interactive"
+    assert al["severity"] == "page"
+    assert al["trigger_trace_id"] == "slow3"
+    assert al["trigger_replica"] == 1
+    assert al["burn_short"] >= 14.0 and al["burn_long"] >= 14.0
+    # Lineage: the decision ledger carries the SAME alert_id.
+    decisions = [r for r in dec.tail() if r["decision"] == "slo_alert"]
+    assert [d["alert_id"] for d in decisions].count(al["alert_id"]) == 1
+    d = next(d for d in decisions if d["alert_id"] == al["alert_id"])
+    assert d["rule"] == "fast_burn"
+    assert d["trace_id"] == "slow3"
+    # Replayability: the firing event is in the bus stream AFTER its
+    # triggering terminal (seq order is the timeline).
+    trigger_seq = max(
+        r["seq"] for r in alerts if r.get("alert_id") == al["alert_id"]
+    )
+    assert trigger_seq >= al["seq"]
+    # Roll the clock past the scaled short window with no traffic: the
+    # snapshot tick resolves the alert and reuses the id.
+    t[0] += 5.0
+    snap = slo.snapshot()
+    assert snap["alerts"]["active"] == []
+    resolved = [a for a in alerts if a["state"] == "resolved"]
+    assert {a["alert_id"] for a in resolved} >= {al["alert_id"]}
+    r = next(a for a in resolved if a["alert_id"] == al["alert_id"])
+    assert r["dur_s"] > 0
+    # Lifetime budget ledger survives window rotation.
+    cls = snap["classes"]["interactive"]
+    assert cls["events"] == 25 and cls["bad"] == 5
+    assert cls["bad_by_objective"] == {"ttft_s": 5}
+    json.dumps(snap)  # the GET /slo body must be JSON-clean
+
+
+def test_slo_cancelled_sketched_but_not_classified():
+    t = [50.0]
+    bus, _, slo, alerts = _mk_slo(lambda: t[0], target=0.9)
+    t[0] += 0.01
+    bus.emit("req_cancelled", replica=0, e2e_s=9.0, queue_wait_s=4.0)
+    snap = slo.snapshot()
+    # The latency lands in the distribution...
+    assert snap["latency"]["fleet"]["e2e_s"]["count"] == 1
+    # ...but burns no budget and fires nothing.
+    assert snap["classes"]["interactive"]["events"] == 0
+    assert alerts == []
+
+
+def test_slo_client_visible_rejects_burn_availability():
+    """fleet=True rejects (and untagged single-loop rejects) are
+    availability-bad; replica-tagged internal refusals the router spills
+    to a peer are not counted."""
+    t = [50.0]
+    bus, _, slo, alerts = _mk_slo(lambda: t[0], target=0.9)
+    t[0] += 0.01
+    bus.emit("req_rejected", replica=1, reason="busy")   # internal spill
+    bus.emit("req_rejected", fleet=True, reason="placement")
+    bus.emit("req_rejected", reason="queue_full")        # single-loop
+    snap = slo.snapshot()
+    cls = snap["classes"]["interactive"]
+    assert cls["events"] == 2 and cls["bad"] == 2
+    assert cls["bad_by_objective"] == {"availability": 2}
+    # 2/2 bad over a 0.1 budget = burn 10 >= fast_burn threshold... but
+    # target 0.9 gives threshold 14 > 10, so only slow_burn can fire.
+    assert all(a["rule"] != "fast_burn" for a in alerts)
+
+
+def test_slo_per_replica_sketches_split_the_fleet():
+    t = [10.0]
+    bus, _, slo, _ = _mk_slo(lambda: t[0])
+    for i in range(50):
+        t[0] += 0.001
+        bus.emit("req_done", replica=i % 2, ttft_s=0.01 + (i % 2) * 1.0,
+                 e2e_s=0.1, queue_wait_s=0.0, tpot_s=0.005)
+    snap = slo.snapshot()
+    per = snap["latency"]["replicas"]
+    assert set(per) == {"0", "1"}
+    assert per["0"]["ttft_s"]["p99"] < 0.1 < per["1"]["ttft_s"]["p99"]
+    fleet = snap["latency"]["fleet"]["ttft_s"]
+    assert fleet["count"] == 50
+    # merged_sketch agrees with the snapshot's fleet summary.
+    assert slo.merged_sketch("ttft_s").summary()["p99"] == fleet["p99"]
+
+
+# ------------------------------- live SLO engine on a real serving fleet
+
+
+_FLEET_CFG = dataclasses.replace(
+    get_preset("tiny").model, compute_dtype="float32"
+)
+
+
+@pytest.fixture(scope="module")
+def fleet_params():
+    return transformer.init_params(_FLEET_CFG, jax.random.key(0))
+
+
+def _slo_fleet(params, n=1, faults=None, bus=None):
+    """A tiny real fleet sharing one in-memory bus. The SLO engine is
+    NOT created here: tests attach it after warmup so jit-compile
+    latency never pollutes the distributions or trips an alert."""
+    if bus is None:
+        bus = EventBus("")
+
+    def factory():
+        return ServingEngine(
+            params, _FLEET_CFG, temperature=0.0, max_batch=2, n_blocks=24,
+            block_size=8, steps_per_sched=4, pipeline_depth=2,
+        )
+
+    reps = [
+        Replica(i, factory, bus=bus, fault_injector=faults)
+        for i in range(n)
+    ]
+    router = Router(reps, bus=bus, eject_backoff_s=0.1)
+    return bus, router
+
+
+def _attach_slo(bus, router, *, ttft_s, e2e_s=120.0, target=0.99):
+    dec = DecisionLog(bus=bus)
+    slo = SLOEngine(
+        classes=default_slo_classes(ttft_s=ttft_s, e2e_s=e2e_s, target=target),
+        bus=bus, decisions=dec,
+    )
+    router.slo = slo
+    alerts = []
+    bus.subscribe(
+        lambda r: alerts.append(r) if r.get("event") == "slo_alert" else None
+    )
+    return dec, slo, alerts
+
+
+def test_fleet_reject_storm_trips_fast_burn(fleet_params):
+    """Satellite: ``reject_storm`` deterministically trips fast_burn.
+    With one replica the storm leaves the router nowhere to spill, so the
+    client sees RejectedBusy and the bus sees a fleet-level
+    ``req_rejected`` — availability burn 1/1 over a 0.01 budget = 100x,
+    over threshold on the very first reject. No timing involved."""
+    bus = EventBus("")
+    faults = ServingFaultInjector("reject_storm@req1:r0", storm_rejects=3,
+                                  bus=bus)
+    # Injector and fleet share the bus: one seq timeline end to end.
+    _, router = _slo_fleet(fleet_params, n=1, faults=faults, bus=bus)
+    router.start()
+    try:
+        # Warm request: compiles, completes, and (as accepted submit #1)
+        # arms the storm on its way in.
+        status, toks, _ = router.submit([1, 2, 3], 4).result(timeout=300)
+        assert status == "done" and len(toks) == 4
+        dec, slo, alerts = _attach_slo(bus, router, ttft_s=2.0)
+
+        with pytest.raises(RejectedBusy):
+            router.submit([4, 5, 6], 4)
+        fast = [a for a in alerts
+                if a["rule"] == "fast_burn" and a["state"] == "firing"]
+        assert len(fast) == 1, "first client-visible reject must page"
+        al = fast[0]
+        assert al["severity"] == "page"
+        # Lineage: the alert_id ties the firing event to its entry in
+        # the decision ledger (the replayable record of WHY we paged).
+        # Burn 100x clears the slow_burn threshold too, so there may be
+        # a second, slower-severity entry alongside.
+        decs = [r for r in dec.tail() if r["decision"] == "slo_alert"]
+        mine = [d for d in decs if d["alert_id"] == al["alert_id"]]
+        assert len(mine) == 1 and mine[0]["rule"] == "fast_burn"
+
+        # Drain the rest of the storm; then the fleet accepts again and
+        # a healthy completion lands in the same budget ledger.
+        for _ in range(2):
+            with pytest.raises(RejectedBusy):
+                router.submit([4, 5, 6], 4)
+        status, toks, _ = router.submit([7, 8, 9], 4).result(timeout=300)
+        assert status == "done"
+        snap = slo.snapshot()
+        cls = snap["classes"]["interactive"]
+        assert cls["bad_by_objective"].get("availability") == 3
+        assert cls["events"] == 4  # 3 rejects + 1 healthy done
+    finally:
+        router.stop()
+
+
+def test_fleet_slow_window_trips_fast_burn_clean_run_silent(fleet_params):
+    """Satellite: ``slow_window`` stretches every scheduler tick by
+    slow_s, so the victim's TTFT is >= slow_s by construction — over a
+    0.15s objective that one bad request out of one is burn 100x and
+    fast_burn fires. The identical fleet with no injector stays silent."""
+    bus = EventBus("")
+    faults = ServingFaultInjector(
+        "slow_window@req2:r0", slow_ticks=6, slow_s=0.3, bus=bus,
+    )
+    _, router = _slo_fleet(fleet_params, n=1, faults=faults, bus=bus)
+    router.start()
+    try:
+        status, _, _ = router.submit([1, 2, 3], 4).result(timeout=300)
+        assert status == "done"
+        dec, slo, alerts = _attach_slo(bus, router, ttft_s=0.15)
+
+        # Accepted submit #2 arms the slow window; its own first tick is
+        # already slowed, so THIS request's ttft >= 0.3 > 0.15.
+        status, toks, _ = router.submit([4, 5, 6], 4).result(timeout=300)
+        assert status == "done" and len(toks) == 4
+        snap = slo.snapshot()
+        assert snap["latency"]["fleet"]["ttft_s"]["min"] >= 0.3
+        fast = [a for a in alerts
+                if a["rule"] == "fast_burn" and a["state"] == "firing"]
+        assert len(fast) == 1
+        assert fast[0]["slo_class"] == "interactive"
+        # Alert -> decision lineage pinned: the paging alert's id shows
+        # up exactly once in the decision ledger, under the same rule.
+        decs = [r for r in dec.tail() if r["decision"] == "slo_alert"]
+        mine = [d for d in decs if d["alert_id"] == fast[0]["alert_id"]]
+        assert len(mine) == 1 and mine[0]["rule"] == "fast_burn"
+    finally:
+        router.stop()
+
+    # Counterpart: same fleet, no faults, generous objective -> silence.
+    bus2, router2 = _slo_fleet(fleet_params, n=1)
+    router2.start()
+    try:
+        router2.submit([1, 2, 3], 4).result(timeout=300)
+        dec2, slo2, alerts2 = _attach_slo(bus2, router2, ttft_s=60.0)
+        for p in ([4, 5], [6, 7, 8], [9]):
+            status, _, _ = router2.submit(p, 4).result(timeout=300)
+            assert status == "done"
+        snap = slo2.snapshot()
+        assert alerts2 == []
+        assert snap["alerts"]["active"] == []
+        cls = snap["classes"]["interactive"]
+        assert cls["events"] == 3 and cls["bad"] == 0
+    finally:
+        router2.stop()
+
+
+def test_fleet_health_surface_and_gateway_endpoints(fleet_params):
+    """Tentpole surface: router.fleet_health() aggregates per-replica
+    health_pull gauges; slo_snapshot() folds it into the SLO body; the
+    gateway serves both GET /slo and GET /metricsz over real HTTP."""
+    bus, router = _slo_fleet(fleet_params, n=2)
+    router.start()
+    try:
+        for p in ([1, 2, 3], [4, 5], [6, 7, 8, 9]):
+            status, _, _ = router.submit(p, 4).result(timeout=300)
+            assert status == "done"
+        dec, slo, _ = _attach_slo(bus, router, ttft_s=60.0)
+
+        fh = router.fleet_health()
+        assert set(fh["replicas"]) == {"0", "1"}
+        for snap_r in fh["replicas"].values():
+            assert snap_r["fence"] == 0
+            assert snap_r["gauges"]["rows_capacity"] == 2
+        fleet = fh["fleet"]
+        assert fleet["replicas_total"] == 2
+        assert fleet["replicas_active"] == 2
+        # Gauges are SUMS across replicas.
+        assert fleet["gauges"]["rows_capacity"] == 4.0
+        assert fleet["gauges"]["pool_total"] == sum(
+            r["gauges"]["pool_total"] for r in fh["replicas"].values()
+        ) > 0
+
+        snap = router.slo_snapshot()
+        assert snap["fleet_health"]["fleet"]["replicas_total"] == 2
+        json.dumps(snap)  # wire-clean
+
+        gw = ServingGateway(router, port=0, slo=slo).start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            with urllib.request.urlopen(base + "/slo", timeout=10) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+            # The router's slo_snapshot wins: fleet health included.
+            assert body["fleet_health"]["fleet"]["replicas_total"] == 2
+            assert body["alerts"]["active"] == []
+            assert body["latency"]["fleet"]["e2e_s"]["count"] >= 0
+            with urllib.request.urlopen(
+                base + "/metricsz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                mz = json.loads(resp.read())
+            assert "gauges" in mz
+        finally:
+            gw.stop()
+    finally:
+        router.stop()
+
+
+def test_build_live_report_reconciles_within_rank_bounds():
+    """The --live reconciliation contract, unit-tested with EXACTLY the
+    analyzer the CI gate runs: live sketch quantiles over a synthetic
+    stream land inside the exact offline rank band; a perturbed snapshot
+    is flagged as a problem."""
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_live_unit", OBS_REPORT
+    )
+    obs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs)
+
+    rng = random.Random(9)
+    t = [1000.0]
+    bus = EventBus(clock=lambda: t[0], wall=lambda: t[0])
+    slo = SLOEngine(
+        classes=default_slo_classes(ttft_s=60.0, e2e_s=600.0),
+        bus=bus, clock=lambda: t[0], window_s=3600.0,
+    )
+    events = []
+    bus.subscribe(events.append)
+    for i in range(300):
+        t[0] += 0.01
+        bus.emit(
+            "req_done", replica=i % 3,
+            ttft_s=rng.lognormvariate(-2.0, 0.8),
+            tpot_s=rng.uniform(0.001, 0.02),
+            e2e_s=rng.lognormvariate(0.0, 0.5),
+            queue_wait_s=abs(rng.gauss(0.0, 0.1)),
+        )
+    snap = slo.snapshot()
+    rep = obs.build_live_report(snap, events)
+    assert rep["problems"] == []
+    for m in obs.LIVE_METRICS:
+        assert rep["reconcile"][m]["checked"], m
+        assert rep["reconcile"][m]["offline_count"] == 300
+    assert rep["alerts_active"] == []
+
+    # Perturb one live quantile far outside the rank band: flagged.
+    bad = json.loads(json.dumps(snap))
+    bad["latency"]["fleet"]["ttft_s"]["p99"] *= 50.0
+    rep_bad = obs.build_live_report(bad, events)
+    assert any("ttft_s p99" in p for p in rep_bad["problems"])
