@@ -65,7 +65,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--mode", default="train", choices=["train", "decode", "trainer",
                                             "serving", "serving-slo",
-                                            "serving-fleet"],
+                                            "serving-fleet", "kernel"],
         help="train: tokens/sec + MFU of the train step (the driver metric); "
         "decode: KV-cached generation tokens/sec; trainer: the FULL Trainer "
         "loop incl. the input pipeline (measures host-sampling overlap — "
@@ -76,7 +76,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         "offline throughput; serving-fleet: the same Poisson load through "
         "the N-replica fleet Router while a --fleet-scenario disturbance "
         "runs (replica kill mid-burst, rolling restart, skewed hot-prefix "
-        "affinity) — measures goodput and redrive cost under failure",
+        "affinity) — measures goodput and redrive cost under failure; "
+        "kernel: ragged paged-attention microbench sweeping (B, T, pages, "
+        "window, int8) lanes over the {gather, ragged, ragged+split, "
+        "ragged+amla} variants — runs anywhere (CPU numbers are interpret-"
+        "mode and labeled cpu_interpret), so kernel-level wins bank even "
+        "while the TPU backend is unreachable",
     )
     parser.add_argument(
         "--steps-per-sched", type=int, default=0,
@@ -453,6 +458,160 @@ def run_decode_bench(args: argparse.Namespace) -> dict:
         rec["metric"] += "_unstacked"  # distinct series vs the stacked layout
         rec["decode_cache_layout"] = "unstacked"
     return rec
+
+
+def run_kernel_bench(args: argparse.Namespace) -> dict:
+    """Ragged paged-attention kernel microbench: the four variants the
+    speed push pits against each other — XLA gather reference, classic
+    single-pass ragged kernel, FA2 KV-split partitioning, and AMLA
+    MUL-by-ADD rescaling — swept over (B, T, pages, window, int8) lanes.
+
+    Runs on whatever backend is up: on TPU the numbers are compiled-
+    kernel wall times; anywhere else the kernel runs in interpret mode
+    and the record carries ``cpu_interpret: true`` — relative variant
+    ordering under interpret is NOT hardware truth, but the record keeps
+    the series alive (and the identity grid honest) while the TPU
+    backend is unreachable. The headline value is the classic ragged
+    kernel's ms on the reference lane; per-variant and per-lane times
+    ride the same record.
+    """
+    import numpy as np
+
+    # Every other mode's knob is rejected, not ignored (same discipline
+    # as the decode guard): the sweep is shape-driven, so a --batch or
+    # --kv-dtype that silently did nothing would mislabel the record.
+    noop = {
+        "--batch": args.batch, "--attention": args.attention,
+        "--remat": args.remat, "--ce": args.ce,
+        "--optimizer": args.optimizer, "--unroll": args.unroll,
+        "--block-q": args.block_q, "--block-kv": args.block_kv,
+        "--steps-per-sched": args.steps_per_sched,
+        "--context": args.context, "--paged-attn": args.paged_attn,
+        "--spec-draft": args.spec_draft, "--no-pipeline": args.no_pipeline,
+        "--pipeline-depth": args.pipeline_depth,
+        "--admit-batch": args.admit_batch,
+        "--grad-dtype": args.grad_dtype, "--ragged": args.ragged,
+        "--kv-dtype": args.kv_dtype,
+        "--cache-layout": args.cache_layout,
+        "--decode-unroll": args.decode_unroll,
+        "--prefix-cache": args.prefix_cache,
+        "--prefix-pool-size": args.prefix_pool_size,
+        "--prefix-len": args.prefix_len,
+        "--prefill-chunk-tokens": args.prefill_chunk_tokens,
+        "--quantize": args.quantize,
+    }
+    bad = [k for k, v in noop.items() if v]
+    if bad:
+        raise ValueError(
+            f"{', '.join(bad)} have no effect on the kernel microbench"
+        )
+
+    import jax
+    import jax.numpy as jnp
+
+    from pretraining_llm_tpu.ops.pallas_ragged import (
+        ragged_gather_attention,
+        ragged_paged_attention,
+    )
+
+    interpret = jax.devices()[0].platform != "tpu"
+    h, g, d, bs = 4, 2, 32, 8
+    # (name, B, T, pages, window, int8) — T mixes decode-like (small) and
+    # chunk-like (T) q_lens inside each lane, pages sets the per-row scan
+    # length the KV split partitions.
+    lanes = [
+        ("mixed", 4, 8, 8, 0, False),
+        ("long_row", 2, 4, 16, 0, False),
+        ("windowed", 4, 8, 8, 24, False),
+        ("int8", 4, 8, 8, 0, True),
+    ]
+    if args.quick:
+        lanes = lanes[:1]
+    reps = 2 if args.quick else 4
+    gather_jit = jax.jit(
+        ragged_gather_attention, static_argnames=("window",)
+    )
+
+    def _time(fn):
+        jax.block_until_ready(fn())  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    rng = np.random.default_rng(0)
+    lane_recs = []
+    for name, b, t, pages, window, int8 in lanes:
+        n_blocks = pages * 3
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        kp = jnp.asarray(
+            rng.normal(size=(n_blocks, bs, g, d)), jnp.float32
+        )
+        vp = jnp.asarray(
+            rng.normal(size=(n_blocks, bs, g, d)), jnp.float32
+        )
+        tbl = jnp.asarray(
+            rng.integers(1, n_blocks, size=(b, pages)), jnp.int32
+        )
+        cap = pages * bs
+        seq = jnp.asarray(
+            rng.integers(cap // 2, cap - t, size=(b,)), jnp.int32
+        )
+        # Ragged q_lens: half the rows decode-like (1), half chunk-like.
+        ql = jnp.asarray(
+            [1 if i % 2 == 0 else t for i in range(b)], jnp.int32
+        )
+        scales = {}
+        if int8:
+            amax = jnp.max(jnp.abs(kp), axis=-1, keepdims=True)
+            ks = jnp.where(amax == 0, 1.0, amax)
+            kp = jnp.clip(
+                jnp.round(kp / ks * 127.0), -127, 127
+            ).astype(jnp.int8)
+            amax = jnp.max(jnp.abs(vp), axis=-1, keepdims=True)
+            vs = jnp.where(amax == 0, 1.0, amax)
+            vp = jnp.clip(
+                jnp.round(vp / vs * 127.0), -127, 127
+            ).astype(jnp.int8)
+            scales = {"k_scale": ks, "v_scale": vs}
+        common = dict(window=window, **scales)
+        splits = max(2, min(4, pages // 2))
+        variants = {
+            "gather": lambda: gather_jit(
+                q, kp, vp, tbl, seq, ql, **common
+            ),
+            "ragged": lambda: ragged_paged_attention(
+                q, kp, vp, tbl, seq, ql, kv_splits=1, **common
+            ),
+            "ragged_split": lambda: ragged_paged_attention(
+                q, kp, vp, tbl, seq, ql, kv_splits=splits, **common
+            ),
+            "ragged_amla": lambda: ragged_paged_attention(
+                q, kp, vp, tbl, seq, ql, kv_splits=1, amla=True, **common
+            ),
+        }
+        times = {k: round(_time(fn), 3) for k, fn in variants.items()}
+        lane_recs.append({
+            "lane": name, "B": b, "T": t, "pages": pages,
+            "window": window, "int8": int8, "kv_splits": splits,
+            "ms": times,
+        })
+        _stamp(f"kernel lane {name}: {times}")
+    ref = lane_recs[0]
+    return {
+        "metric": "kernel_ragged_microbench_ms",
+        "value": ref["ms"]["ragged"],
+        "unit": "ms",
+        "vs_baseline": None,
+        # CPU interpret numbers are NOT hardware perf — consumers
+        # (bank_results, BASELINE tables) must label the series.
+        "cpu_interpret": interpret,
+        "device": jax.devices()[0].device_kind,
+        "variants": dict(ref["ms"]),
+        "lanes": lane_recs,
+        "shape": {"heads": h, "kv_heads": g, "head_dim": d,
+                  "block_size": bs},
+    }
 
 
 _QUANT_SUFFIX = {"int8": "_q8", "int8-kv": "_q8kv"}
@@ -1362,6 +1521,8 @@ def run_bench(args: argparse.Namespace) -> dict:
         return run_serving_slo_bench(args)
     if args.mode == "serving-fleet":
         return run_serving_fleet_bench(args)
+    if args.mode == "kernel":
+        return run_kernel_bench(args)
 
     # Decode-only knobs are REJECTED on the train path (mirror of the
     # decode-mode noop guard): a silently-ignored flag would emit a record
@@ -1564,6 +1725,8 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
     elif args.mode == "serving-slo":
         metric = f"serving_slo_goodput_{args.preset}"
         unit = "slo_ok_requests_per_sec"
+    elif args.mode == "kernel":
+        metric, unit = "kernel_ragged_microbench_ms", "ms"
     else:
         metric, unit = f"mfu_{args.preset}_train", "fraction_of_peak_bf16"
         if args.context:
@@ -1574,7 +1737,9 @@ def error_result(args: argparse.Namespace, msg: str, attempts: int) -> dict:
         "unit": unit,
         # Same null contract as the success path: decode/serving have no
         # reference baseline, so their failure records carry null too.
-        "vs_baseline": None if args.mode in ("decode", "serving", "serving-slo") else 0.0,
+        "vs_baseline": None
+        if args.mode in ("decode", "serving", "serving-slo", "kernel")
+        else 0.0,
         "error": msg[:800],
         "attempts": attempts,
     }

@@ -190,6 +190,18 @@ class ModelConfig:
     # "kernel" fuses the scale-page dequant into the ragged kernel's page
     # loop (only int8 bytes + scales cross HBM).
     paged_attention_impl: str = "gather"  # gather | kernel
+    # Ragged-kernel speed knobs (paged_attention_impl="kernel" only; the
+    # gather path ignores both). `ragged_kv_splits` partitions each row's
+    # page range across that many parallel grid lanes (FA2 work
+    # partitioning with a log-sum-exp combine): 1 = single-pass kernel
+    # (the pre-split default, bit-compatible), 0 = auto-tune from
+    # (max_pages, B), >1 = forced count. `ragged_amla` switches the
+    # online softmax to AMLA's exp2 MUL-by-ADD rescale (per-page
+    # correction as an exponent-field add; int8 dequant scales absorbed
+    # into the same restructure). Defaults keep the proven numerics —
+    # flips are bench-gated (BASELINE.md re-race procedure).
+    ragged_kv_splits: int = 1  # 0 = auto | 1 = off | >1 = forced
+    ragged_amla: bool = False
 
     def __post_init__(self) -> None:
         if self.kv_cache_dtype not in ("compute", "int8"):
@@ -206,6 +218,11 @@ class ModelConfig:
         # after the pool gather, "kernel" routes every query shape through
         # the ragged kernel, which fuses the scale-page dequant into its
         # page loop (ops/pallas_ragged.py).
+        if self.ragged_kv_splits < 0:
+            raise ValueError(
+                f"ragged_kv_splits must be >= 0 (0 = auto), got "
+                f"{self.ragged_kv_splits}"
+            )
         if self.activation not in _ACTIVATIONS:
             raise ValueError(f"activation must be one of {_ACTIVATIONS}, got {self.activation!r}")
         if self.norm not in _NORMS:

@@ -33,7 +33,10 @@ import jax
 import jax.numpy as jnp
 
 from pretraining_llm_tpu.config import ModelConfig
-from pretraining_llm_tpu.generation.sampling import sample_logits
+from pretraining_llm_tpu.generation.sampling import (
+    sample_logits,
+    sample_logits_fused,
+)
 from pretraining_llm_tpu.models import transformer
 from pretraining_llm_tpu.models.transformer import PagedInfo
 
@@ -528,11 +531,16 @@ def prefill_suffix_into_pool_batched(
 
 def _forward_sample_one(
     params, pools, tokens, block_tables, seq_lens, key, cfg,
-    temperature, top_k, top_p, min_p, mesh=None,
+    temperature, top_k, top_p, min_p, mesh=None, logprobs_k=0,
 ):
     """The single decode step both jitted entry points trace: forward one
     token per row through the paged cache, sample the next. Kept as ONE
-    definition so the sps=1 and windowed paths can never diverge."""
+    definition so the sps=1 and windowed paths can never diverge.
+
+    Returns ``(next_token (B,), logprobs, pools)`` — ``logprobs`` is
+    ``None`` unless ``logprobs_k > 0``, in which case it is the
+    ``(values (B, k), ids (B, k))`` top-k log-softmax of the raw logits
+    (the decode-fused host payload; see `sample_logits_fused`)."""
     from pretraining_llm_tpu.parallel.sharding import activation_mesh
 
     with activation_mesh(mesh):
@@ -543,11 +551,11 @@ def _forward_sample_one(
             kv_cache=pools,
             paged=PagedInfo(block_tables, seq_lens),
         )
-        nxt = sample_logits(
+        nxt, lp = sample_logits_fused(
             logits[:, 0], key, temperature=temperature, top_k=top_k,
-            top_p=top_p, min_p=min_p,
+            top_p=top_p, min_p=min_p, logprobs_k=logprobs_k,
         )
-        return nxt.astype(jnp.int32), pools
+        return nxt.astype(jnp.int32), lp, pools
 
 
 @functools.partial(
@@ -579,10 +587,11 @@ def paged_decode_step(
     ``key`` preserves the existing sps=1 sampling stream, where the scan
     would consume split(key, 1)[0].)
     """
-    return _forward_sample_one(
+    nxt, _, pools = _forward_sample_one(
         params, pools, tokens, block_tables, seq_lens, key, cfg,
         temperature, top_k, top_p, min_p, mesh,
     )
+    return nxt, pools
 
 
 @functools.partial(
@@ -757,7 +766,7 @@ def paged_decode_steps(
 
     def one(carry, sub):
         pools, tok, seq = carry
-        nxt, pools = _forward_sample_one(
+        nxt, _, pools = _forward_sample_one(
             params, pools, tok, block_tables, seq, sub, cfg,
             temperature, top_k, top_p, min_p, mesh,
         )
@@ -768,3 +777,148 @@ def paged_decode_steps(
         one, (pools, tokens, seq_lens), subs
     )
     return toks.T, pools  # (B, n_steps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "min_p",
+                     "mesh", "logprobs_k"),
+    donate_argnums=(1,),
+)
+def paged_decode_step_lp(
+    params: Any,
+    pools: transformer.KVCache,
+    tokens: jax.Array,  # (B,) int32
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    seq_lens: jax.Array,  # (B,) int32
+    key: jax.Array,
+    cfg: ModelConfig,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+    logprobs_k: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array, transformer.KVCache]:
+    """`paged_decode_step` plus the top-k logprob payload (raw ``key``,
+    preserving the sps=1 sampling stream exactly like its twin).
+    Returns ``(tokens (B,), lp_values (B, k), lp_ids (B, k), pools)``."""
+    nxt, lp, pools = _forward_sample_one(
+        params, pools, tokens, block_tables, seq_lens, key, cfg,
+        temperature, top_k, top_p, min_p, mesh, logprobs_k=logprobs_k,
+    )
+    return nxt, lp[0], lp[1], pools
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
+                     "min_p", "mesh", "logprobs_k"),
+    donate_argnums=(1,),
+)
+def paged_decode_steps_lp(
+    params: Any,
+    pools: transformer.KVCache,
+    tokens: jax.Array,  # (B,) int32
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    seq_lens: jax.Array,  # (B,) int32
+    key: jax.Array,
+    cfg: ModelConfig,
+    n_steps: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+    mesh: Any = None,
+    logprobs_k: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array, transformer.KVCache]:
+    """`paged_decode_steps` with the top-k logprob payload.
+
+    Same scan, same key stream (split(key, n_steps)), same token
+    numerics — the ONLY addition is the per-step (values, ids) top-k
+    log-softmax of each step's raw logits, computed inside the same
+    device program so the host still receives token ids + a (B, n, k)
+    sliver instead of (B, n, V) logits.
+
+    Returns ``(tokens (B, n_steps), lp_values (B, n_steps, k) f32,
+    lp_ids (B, n_steps, k) int32, pools)``.
+    """
+
+    def one(carry, sub):
+        pools, tok, seq = carry
+        nxt, lp, pools = _forward_sample_one(
+            params, pools, tok, block_tables, seq, sub, cfg,
+            temperature, top_k, top_p, min_p, mesh,
+            logprobs_k=logprobs_k,
+        )
+        return (pools, nxt, seq + 1), (nxt, lp[0], lp[1])
+
+    subs = jax.random.split(key, n_steps)
+    (pools, _, _), (toks, lp_vals, lp_ids) = jax.lax.scan(
+        one, (pools, tokens, seq_lens), subs
+    )
+    return (
+        toks.T,  # (B, n_steps)
+        lp_vals.transpose(1, 0, 2),  # (B, n_steps, k)
+        lp_ids.transpose(1, 0, 2),
+        pools,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh"),
+    donate_argnums=(1,),
+)
+def paged_decode_logits(
+    params: Any,
+    pools: transformer.KVCache,
+    tokens: jax.Array,  # (B,) int32
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    seq_lens: jax.Array,  # (B,) int32
+    cfg: ModelConfig,
+    mesh: Any = None,
+) -> Tuple[jax.Array, transformer.KVCache]:
+    """UNFUSED decode forward: one step, raw (B, V) last-position logits.
+
+    The measurement/fallback lane for decode-fused sampling: forward
+    only, with token selection left to a SEPARATE `sample_tokens`
+    dispatch — exactly the extra device→host logits round-trip the fused
+    path (`paged_decode_step[s]` / `_lp`) eliminates. The serving engine
+    keeps this lane wired (``fused_sampling=False``) so fused-vs-unfused
+    greedy bit-identity stays testable and the transfer win stays
+    benchable.
+    """
+    from pretraining_llm_tpu.parallel.sharding import activation_mesh
+
+    with activation_mesh(mesh):
+        logits, pools = transformer.forward(
+            params,
+            tokens[:, None],
+            cfg,
+            kv_cache=pools,
+            paged=PagedInfo(block_tables, seq_lens),
+        )
+    return logits[:, 0].astype(jnp.float32), pools
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("temperature", "top_k", "top_p", "min_p"),
+)
+def sample_tokens(
+    logits: jax.Array,  # (B, V) f32
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
+) -> jax.Array:
+    """The unfused lane's second dispatch: `sample_logits` as its own
+    jitted program over host-visible logits. Same math as the fused
+    in-program sampling (JAX PRNG is jit-boundary invariant), so fused
+    vs unfused token streams are bit-identical given identical logits."""
+    return sample_logits(
+        logits, key, temperature=temperature, top_k=top_k, top_p=top_p,
+        min_p=min_p,
+    )

@@ -104,6 +104,7 @@ class _Window:
     snapshot: List[tuple]           # [(row, _Request)] at dispatch time
     n: int                          # decode: window length; spec: k+1 bound
     toks: Any = None                # decode: (B, n) device tokens
+    lp: Any = None                  # decode: ((B, n, k) values, ids) device
     emit: Any = None                # spec: (B, k+1) device emissions
     n_emit: Any = None              # spec: (B,) device per-row emit counts
     seq_dev: Any = None             # spec: (B,) device frontier at dispatch
@@ -152,6 +153,8 @@ class ServingEngine:
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
         spec_k: int = 0,
+        fused_sampling: bool = True,
+        logprobs_k: int = 0,
     ):
         if cfg.n_experts:
             # Same restriction as ragged generate: pad slots inside a
@@ -173,6 +176,36 @@ class ServingEngine:
                 "speculative serving needs all three of draft_params, "
                 "draft_cfg and spec_k >= 1 (or none of them)"
             )
+        # Decode-fused sampling (default): token selection runs INSIDE
+        # the jitted decode window, so each window ships (B, n) token ids
+        # (plus an optional (B, n, k) logprob sliver) back to the host
+        # instead of per-step (B, V) logits. fused_sampling=False keeps
+        # the unfused lane wired — forward-only program, a full logits
+        # device->host round-trip, then a separate sampling dispatch per
+        # step — as the measurement/bit-identity reference (greedy output
+        # is identical by construction; tests pin it).
+        self.fused_sampling = bool(fused_sampling)
+        if logprobs_k < 0:
+            raise ValueError(f"logprobs_k must be >= 0, got {logprobs_k}")
+        if logprobs_k and not fused_sampling:
+            raise ValueError(
+                "logprobs_k requires fused_sampling (the logprob sliver "
+                "rides the fused decode payload)"
+            )
+        if spec_k and (not fused_sampling or logprobs_k):
+            raise ValueError(
+                "speculative serving supports only the fused decode path "
+                "without logprobs (spec rounds never materialize "
+                "per-token logits host-side)"
+            )
+        self.logprobs_k = int(logprobs_k)
+        # Per-request top-k logprobs, keyed by rid, one entry per OUTPUT
+        # token in order: (values, token_ids) lists of length logprobs_k,
+        # or None for tokens sampled inside prefill programs (each
+        # request's first token, incl. post-preemption restarts) — those
+        # programs don't compute the sliver. Populated only when
+        # logprobs_k > 0; aligned with the finished[rid] token list.
+        self.logprobs: Dict[int, List[Optional[tuple]]] = {}
         self.spec_k = int(spec_k)
         self.draft_params = draft_params
         self.draft_cfg: Optional[ModelConfig] = None
@@ -438,6 +471,10 @@ class ServingEngine:
             # signal (interleaved ≫ dedicated under decode load).
             "prefill_chunks": 0, "prefill_chunk_tokens": 0,
             "chunk_windows_interleaved": 0, "chunk_windows_dedicated": 0,
+            # Unfused-lane telemetry: bytes of raw (B, V) logits pulled
+            # to the host per decode step. Stays 0 with fused sampling
+            # (the default) — the transfer the fused path deletes.
+            "logits_bytes_host": 0,
         }
         # Cross-request prefix cache: content-addressed page reuse over
         # the allocator (generation/prefix_cache.py). Off by default —
@@ -731,28 +768,88 @@ class ServingEngine:
         # here is on the WINDOW-START state only.
         paged.check_paged_bounds(self.tables, self.seq_lens, self.block_size)
         self._key, sub = jax.random.split(self._key)
-        common = dict(
-            cfg=self.cfg, temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+        toks, lp = self._decode_window(
+            jnp.asarray(self.tokens), jnp.asarray(self.tables),
+            jnp.asarray(self.seq_lens), sub, n, raw_key_single=True,
         )
-        dev_args = (
-            self.params, self.pools, jnp.asarray(self.tokens),
-            jnp.asarray(self.tables), jnp.asarray(self.seq_lens), sub,
-        )
-        if n == 1:
-            nxt, self.pools = paged.paged_decode_step(*dev_args, **common)
-            window = np.asarray(nxt)[:, None]  # (B, 1)
-        else:
-            toks, self.pools = paged.paged_decode_steps(
-                *dev_args, n_steps=n, **common
-            )
-            window = np.asarray(toks)  # (B, n)
+        window = np.asarray(toks)  # (B, n)
+        lp_host = None
+        if lp is not None:
+            lp_host = (np.asarray(lp[0]), np.asarray(lp[1]))
         self.stats["steps"] += n
         for row, req in enumerate(self.rows):
             if req is None or req.prefill_pos is not None:
                 continue
-            self._consume_tokens(req, row, window[row], advance_seq=True)
+            self._consume_tokens(
+                req, row, window[row], advance_seq=True,
+                lp=None if lp_host is None
+                else (lp_host[0][row], lp_host[1][row]),
+            )
         return True
+
+    def _decode_window(self, base, tables_dev, seq_dev, key, n,
+                       raw_key_single=False):
+        """ONE definition of the decode-window device dispatch for the
+        synchronous and pipelined schedulers. Returns ``(toks, lp)``:
+        ``toks`` a (B, n) DEVICE token array (the pipelined path chains
+        its last column without a sync), ``lp`` None or the device
+        ``((B, n, k) values, (B, n, k) ids)`` logprob sliver.
+
+        Fused (default): sampling runs inside the jitted step program —
+        the host payload per window is token ids (+ the optional
+        sliver), never logits. Unfused: the measurement/reference lane —
+        per step, a forward-only program returns full (B, V) logits,
+        they cross device->host (counted in stats["logits_bytes_host"]),
+        and a SEPARATE sampling dispatch picks the token. Greedy output
+        is bit-identical between the two lanes by construction: same
+        forward, same argmax, same key stream (``raw_key_single`` keeps
+        the sync n==1 path on the raw key exactly like
+        paged_decode_step)."""
+        common = dict(
+            cfg=self.cfg, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+        )
+        single = n == 1 and raw_key_single
+        if not self.fused_sampling:
+            skeys = [key] if single else list(jax.random.split(key, n))
+            sample_kw = dict(
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, min_p=self.min_p,
+            )
+            tok, seq, cols = base, seq_dev, []
+            for sub in skeys:
+                logits, self.pools = paged.paged_decode_logits(
+                    self.params, self.pools, tok, tables_dev, seq,
+                    cfg=self.cfg, mesh=self.mesh,
+                )
+                # THE round-trip fused sampling deletes: every step pays
+                # a (B, V) f32 device->host transfer + a second dispatch.
+                logits_host = np.asarray(logits)
+                self.stats["logits_bytes_host"] += logits_host.nbytes
+                tok = paged.sample_tokens(
+                    jnp.asarray(logits_host), sub, **sample_kw
+                )
+                cols.append(tok)
+                seq = seq + 1
+            return jnp.stack(cols, axis=1), None
+        dev_args = (self.params, self.pools, base, tables_dev, seq_dev, key)
+        if self.logprobs_k:
+            if single:
+                nxt, lpv, lpi, self.pools = paged.paged_decode_step_lp(
+                    *dev_args, logprobs_k=self.logprobs_k, **common
+                )
+                return nxt[:, None], (lpv[:, None], lpi[:, None])
+            toks, lpv, lpi, self.pools = paged.paged_decode_steps_lp(
+                *dev_args, n_steps=n, logprobs_k=self.logprobs_k, **common
+            )
+            return toks, (lpv, lpi)
+        if single:
+            nxt, self.pools = paged.paged_decode_step(*dev_args, **common)
+            return nxt[:, None], None
+        toks, self.pools = paged.paged_decode_steps(
+            *dev_args, n_steps=n, **common
+        )
+        return toks, None
 
     def _spec_step(self) -> bool:
         """One speculative round for every active row: k draft proposals,
@@ -926,11 +1023,9 @@ class ServingEngine:
                 base = jnp.asarray(self.tokens)
             base = self._merge_admitted(base)
             self._key, sub = jax.random.split(self._key)
-            toks, self.pools = paged.paged_decode_steps(
-                self.params, self.pools, base, jnp.asarray(self.tables),
-                jnp.asarray(seq_dispatch), sub, cfg=self.cfg, n_steps=n,
-                temperature=self.temperature, top_k=self.top_k,
-                top_p=self.top_p, min_p=self.min_p, mesh=self.mesh,
+            toks, lp = self._decode_window(
+                base, jnp.asarray(self.tables), jnp.asarray(seq_dispatch),
+                sub, n,
             )
         self.stats["steps"] += n
         self.stats["windows"] += 1
@@ -939,7 +1034,7 @@ class ServingEngine:
             self.seq_lens[i] = min(int(self.seq_lens[i]) + n, capacity)
         self._inflight.append(
             _Window(kind="decode", snapshot=snapshot, n=n, toks=toks,
-                    t_dispatch=time.perf_counter())
+                    lp=lp, t_dispatch=time.perf_counter())
         )
 
     def _dispatch_spec_round(self) -> None:
@@ -1025,6 +1120,9 @@ class ServingEngine:
                     n_emit = np.asarray(w.n_emit)  # (B,)
                 else:
                     window = np.asarray(w.toks)    # (B, n) — THE sync point
+                    lp_host = None
+                    if w.lp is not None:
+                        lp_host = (np.asarray(w.lp[0]), np.asarray(w.lp[1]))
             t_reaped = time.perf_counter()
             blocked = t_reaped - t0
             meta["host_blocked_s"] = round(blocked, 6)
@@ -1082,7 +1180,9 @@ class ServingEngine:
                     )
                 else:
                     self._consume_tokens(
-                        req, row, window[row], advance_seq=False
+                        req, row, window[row], advance_seq=False,
+                        lp=None if lp_host is None
+                        else (lp_host[0][row], lp_host[1][row]),
                     )
             if self.capacity is not None:
                 # Occupancy sample AT the reap sync point: every value is
@@ -1114,25 +1214,42 @@ class ServingEngine:
                 )
 
     def _consume_tokens(self, req: _Request, row: int, toks,
-                        advance_seq: bool) -> None:
+                        advance_seq: bool, lp=None) -> None:
         """ONE definition of per-token reaping for all three schedulers
         (synchronous window, speculative round, pipelined reap): append
         to the output, update the row's pending token, finish on
         stop/max_new and DISCARD the surplus. ``advance_seq``: the
         synchronous and speculative paths advance the frontier here (the
         step that produced the token wrote its slot); the pipelined path
-        already advanced it at dispatch."""
-        for tok in (int(t) for t in toks):
+        already advanced it at dispatch. ``lp``: this row's
+        ``((n, k) values, (n, k) ids)`` logprob slice — consumed in
+        lockstep with the tokens, so surplus logprobs are discarded with
+        their surplus tokens."""
+        for i, tok in enumerate(int(t) for t in toks):
             if advance_seq:
                 self.seq_lens[row] += 1
             self._check_token(req, tok)
             req.generated.append(tok)
+            self._lp_append(
+                req,
+                None if lp is None
+                else (lp[0][i].tolist(), lp[1][i].tolist()),
+            )
             self._emit_token(req, tok)
             self.tokens[row] = tok
             self.stats["tokens"] += 1
             if tok == self.stop_token or len(req.generated) >= req.max_new:
                 self._finish(req)
                 break  # surplus tokens for this row are discarded
+
+    def _lp_append(self, req: _Request, entry) -> None:
+        """Record one output token's logprob entry (or its absence) —
+        kept in lockstep with every ``generated.append`` so the per-rid
+        list aligns with the final output across preemptions (prefix
+        tokens keep the entries from their first incarnation)."""
+        if not self.logprobs_k:
+            return
+        self.logprobs.setdefault(req.rid, []).append(entry)
 
     def _emit_token(self, req: _Request, tok: int) -> None:
         """Post-append commit hook: first-token timestamp + the streaming
@@ -1251,6 +1368,9 @@ class ServingEngine:
         tok = int(np.asarray(arr)[i])
         self._check_token(req, tok)
         req.generated.append(tok)
+        # Prefill programs sample but never compute the logprob sliver:
+        # the first token's entry is an explicit None placeholder.
+        self._lp_append(req, None)
         self._emit_token(req, tok)
         if req.row is not None:
             self.tokens[req.row] = tok
@@ -1550,6 +1670,7 @@ class ServingEngine:
             for i, req in enumerate(group):
                 tok = int(toks[i])
                 req.generated.append(tok)
+                self._lp_append(req, None)  # prefill-sampled: no sliver
                 self._emit_token(req, tok)
                 self.tokens[req.row] = tok
                 if tok == self.stop_token or len(req.generated) >= req.max_new:
@@ -1697,6 +1818,7 @@ class ServingEngine:
                 req = group[i]
                 tok = int(toks[i])
                 req.generated.append(tok)
+                self._lp_append(req, None)  # prefill-sampled: no sliver
                 self._emit_token(req, tok)
                 self.tokens[req.row] = tok
                 if tok == self.stop_token or len(req.generated) >= req.max_new:
@@ -1890,6 +2012,12 @@ class ServingEngine:
         if self.stop_token is not None and out and out[-1] == self.stop_token:
             out = out[:-1]
         self.finished[req.rid] = out
+        if self.logprobs_k:
+            # Stop-token stripping above must strip its entry too: keep
+            # the per-rid list exactly aligned with the output tokens.
+            lps = self.logprobs.get(req.rid)
+            if lps is not None and len(lps) > len(out):
+                self.logprobs[req.rid] = lps[: len(out)]
         t = self.req_timing.get(req.rid)
         if t is not None:
             t["end_s"] = self._now()

@@ -376,6 +376,8 @@ def _attention_block(
                 window=cfg.sliding_window,
                 k_scale=new_kv["k_scale_pool"],
                 v_scale=new_kv["v_scale_pool"],
+                kv_splits=cfg.ragged_kv_splits or None,
+                amla=cfg.ragged_amla,
             )
         elif cfg.paged_attention_impl == "kernel":
             # Gather-free: the Pallas kernel DMAs each row's pages straight
@@ -399,6 +401,8 @@ def _attention_block(
                     new_kv["v_pool"].astype(cdt),
                     tables, seq, paged.q_lens,
                     window=cfg.sliding_window,
+                    kv_splits=cfg.ragged_kv_splits or None,
+                    amla=cfg.ragged_amla,
                 )
             else:
                 from pretraining_llm_tpu.ops.pallas_paged import (

@@ -527,15 +527,17 @@ class ServingFaultInjector:
 
         orig = engine._consume_tokens
 
-        def _bad(req, row, toks, advance_seq=True):
+        def _bad(req, row, toks, advance_seq=True, **kw):
+            # **kw forwards commit-path extras (e.g. the fused-sampling
+            # logprob sliver) untouched — only the token ids are forged.
             if len(toks) == 0:
-                return orig(req, row, toks, advance_seq)
+                return orig(req, row, toks, advance_seq, **kw)
             engine._consume_tokens = orig
             bad = np.array(
                 [engine.cfg.vocab_size + 7] + [int(t) for t in toks[1:]],
                 dtype=np.int64,
             )
-            return orig(req, row, bad, advance_seq)
+            return orig(req, row, bad, advance_seq, **kw)
 
         engine._consume_tokens = _bad
         return True

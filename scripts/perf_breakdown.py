@@ -52,12 +52,61 @@ def timed(body, init_carry, n2=12, n1=3):
     return (t2 - t1) / (n2 - n1) * 1e3  # ms per iteration
 
 
+def ragged_kernel_breakdown() -> None:
+    """Decode-side component lane: the four ragged paged-attention
+    variants (XLA gather, classic ragged, FA2 KV-split, AMLA rescale)
+    through the same two-length-slope protocol. One JSON line each; the
+    output feeds queries as next-round carry so iterations serialize.
+    Off-TPU the kernel runs in interpret mode — labeled, not comparable
+    to chip numbers.
+    """
+    import numpy as np
+
+    from pretraining_llm_tpu.ops.pallas_ragged import (
+        ragged_gather_attention,
+        ragged_paged_attention,
+    )
+
+    interpret = jax.devices()[0].platform != "tpu"
+    h, g, d, bs, b, t, pages = 4, 2, 32, 8, 4, 8, 8
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(pages * 3, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pages * 3, bs, g, d)), jnp.float32)
+    tbl = jnp.asarray(rng.integers(1, pages * 3, size=(b, pages)), jnp.int32)
+    seq = jnp.asarray(rng.integers(pages * bs // 2, pages * bs - t, size=(b,)), jnp.int32)
+    ql = jnp.asarray([1 if i % 2 == 0 else t for i in range(b)], jnp.int32)
+
+    variants = {
+        "gather": lambda c: ragged_gather_attention(c, kp, vp, tbl, seq, ql),
+        "ragged": lambda c: ragged_paged_attention(c, kp, vp, tbl, seq, ql, kv_splits=1),
+        "ragged_split": lambda c: ragged_paged_attention(c, kp, vp, tbl, seq, ql, kv_splits=4),
+        "ragged_amla": lambda c: ragged_paged_attention(c, kp, vp, tbl, seq, ql, kv_splits=1, amla=True),
+    }
+    for name, fn in variants.items():
+        ms = timed(lambda c, fn=fn: fn(c).astype(c.dtype), q, n2=8, n1=2)
+        print(json.dumps({
+            "component": f"ragged_kernel_{name}", "ms": round(ms, 3),
+            "cpu_interpret": interpret,
+            "shape": {"B": b, "T": t, "pages": pages, "block_size": bs},
+        }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="gpt2-124m")
     ap.add_argument("--batch", type=int, default=24)
     ap.add_argument("--remat", default="full")
+    ap.add_argument(
+        "--ragged-kernel", action="store_true",
+        help="time the ragged paged-attention variants instead of the "
+        "train-step components (runs anywhere; interpret-mode off-TPU)",
+    )
     args = ap.parse_args()
+
+    if args.ragged_kernel:
+        ragged_kernel_breakdown()
+        return
 
     cfg = get_preset(args.preset)
     model = dataclasses.replace(

@@ -1,0 +1,192 @@
+"""Decode-fused sampling: token selection inside the jitted step program.
+
+The fused decode window ships (B, n) token ids — plus an optional
+(B, n, k) logprob sliver — back to the host instead of per-step (B, V)
+logits. The unfused lane (forward-only program + host logits round-trip
++ separate sampling dispatch) stays wired as the measurement reference:
+greedy output must be BIT-IDENTICAL fused vs unfused across every
+scheduler (sync, pipelined, chunked prefill, prefix cache), and the
+step-program output shapes must prove the host-transfer claim.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pretraining_llm_tpu.config import get_preset
+from pretraining_llm_tpu.generation import paged
+from pretraining_llm_tpu.generation.serving import ServingEngine
+from pretraining_llm_tpu.models import transformer
+
+CFG = dataclasses.replace(get_preset("tiny").model, compute_dtype="float32")
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1, d_model=16, n_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _prompts(n, lengths=(5, 9, 14, 7, 11, 3, 16, 6)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.integers(0, CFG.vocab_size, size=int(lengths[i % len(lengths)]))
+        .tolist()
+        for i in range(n)
+    ]
+
+
+ENGINE_CONFIGS = {
+    "sync": dict(_pipeline=False, steps_per_sched=3),
+    "sync_sps1": dict(_pipeline=False, steps_per_sched=1),
+    "pipelined": dict(_pipeline=True, pipeline_depth=2, steps_per_sched=3),
+    "pipelined_depth1": dict(_pipeline=True, pipeline_depth=1,
+                             steps_per_sched=1),
+    "chunked_prefill": dict(_pipeline=True, pipeline_depth=2,
+                            steps_per_sched=3, prefill_chunk_tokens=8),
+    "prefix_cache": dict(_pipeline=True, pipeline_depth=2,
+                         steps_per_sched=3, prefix_cache=True),
+}
+
+
+def _run(params, prompts, n_new, *, fused, logprobs_k=0, **kw):
+    kw = dict(kw)
+    pipeline = kw.pop("_pipeline")
+    eng = ServingEngine(
+        params, CFG, temperature=0.0, max_batch=2, n_blocks=24,
+        block_size=8, fused_sampling=fused, logprobs_k=logprobs_k, **kw,
+    )
+    for p in prompts:
+        eng.submit(p, n_new)
+    out = eng.run(pipeline=pipeline)
+    return out, eng
+
+
+@pytest.mark.parametrize("config", sorted(ENGINE_CONFIGS))
+def test_fused_vs_unfused_greedy_bit_identity(params, config):
+    """The tentpole contract: moving sampling into the step program must
+    not move a single greedy token, under every scheduler — admission
+    churn, chunked prefill, and prefix-cache reuse included."""
+    prompts = _prompts(5)
+    kw = ENGINE_CONFIGS[config]
+    fused_out, fused_eng = _run(params, prompts, 9, fused=True, **kw)
+    unfused_out, unfused_eng = _run(params, prompts, 9, fused=False, **kw)
+    assert fused_out == unfused_out
+    # The transfer claim, engine-side: only the unfused lane ever moves
+    # (B, V) logits across the device boundary.
+    assert fused_eng.stats["logits_bytes_host"] == 0
+    assert unfused_eng.stats["logits_bytes_host"] > 0
+
+
+def test_step_program_ships_tokens_not_logits(params):
+    """Output-shape proof of the host-transfer claim: the fused window
+    program returns (B, n) ids + (B, n, k) sliver; only the unfused
+    forward returns (B, V) logits."""
+    bs, n_blocks = 8, 16
+    prompts = _prompts(2)
+    pools = transformer.make_paged_kv_pool(CFG, n_blocks, bs, dtype="float32")
+    alloc = paged.BlockAllocator(n_blocks)
+    tables = np.zeros((2, 4), np.int32)
+    seq = np.zeros((2,), np.int32)
+    toks0 = np.zeros((2,), np.int32)
+    for i, p in enumerate(prompts):
+        ids = alloc.alloc(4)
+        last, pools = paged.prefill_into_pool(
+            params, CFG, pools, p, ids[: paged.required_blocks(len(p), bs)]
+        )
+        tables[i, : len(ids)] = ids
+        seq[i] = len(p)
+        toks0[i] = int(np.argmax(np.asarray(last)))
+    b, v, n, k = 2, CFG.vocab_size, 4, 3
+    args = (jnp.asarray(toks0), jnp.asarray(tables), jnp.asarray(seq))
+
+    toks, lp_vals, lp_ids, pools = paged.paged_decode_steps_lp(
+        params, pools, *args, jax.random.key(1), CFG, n, logprobs_k=k
+    )
+    assert toks.shape == (b, n) and toks.dtype == jnp.int32
+    assert lp_vals.shape == (b, n, k) and lp_vals.dtype == jnp.float32
+    assert lp_ids.shape == (b, n, k) and lp_ids.dtype == jnp.int32
+    # Per-window host payload: ids + sliver vs n full logit planes.
+    assert toks.size + lp_vals.size + lp_ids.size < b * n * v
+
+    nxt, lv, li, pools = paged.paged_decode_step_lp(
+        params, pools, jnp.asarray(toks)[:, -1], jnp.asarray(tables),
+        jnp.asarray(seq) + n, jax.random.key(2), CFG, logprobs_k=k,
+    )
+    assert nxt.shape == (b,) and lv.shape == (b, k) and li.shape == (b, k)
+
+    logits, pools = paged.paged_decode_logits(
+        params, pools, nxt, jnp.asarray(tables), jnp.asarray(seq) + n + 1,
+        CFG,
+    )
+    assert logits.shape == (b, v) and logits.dtype == jnp.float32
+    # Greedy consistency between the lanes, same pool state.
+    assert np.array_equal(
+        np.asarray(paged.sample_tokens(logits, jax.random.key(3))),
+        np.asarray(jnp.argmax(logits, axis=-1)),
+    )
+
+
+@pytest.mark.parametrize("config", ["sync", "pipelined", "chunked_prefill",
+                                    "prefix_cache"])
+def test_logprobs_sliver_alignment(params, config):
+    """logprobs_k > 0: one entry per output token in order; prefill-
+    sampled first tokens carry None (no sliver in prefill programs);
+    every decode entry's top-1 id equals the emitted greedy token and
+    its values are descending finite log-probabilities."""
+    prompts = _prompts(4)
+    n_new = 7
+    out, eng = _run(params, prompts, n_new, fused=True, logprobs_k=3,
+                    **ENGINE_CONFIGS[config])
+    assert set(eng.logprobs) == set(out)
+    for rid, toks in out.items():
+        lps = eng.logprobs[rid]
+        assert len(lps) == len(toks)
+        assert lps[0] is None  # prefill-sampled first token
+        for tok, entry in zip(toks[1:], lps[1:]):
+            if entry is None:  # post-preemption restart slot
+                continue
+            vals, ids = entry
+            assert len(vals) == 3 and len(ids) == 3
+            assert ids[0] == tok  # greedy token IS the top-1 logprob id
+            assert all(v <= 0.0 and np.isfinite(v) for v in vals)
+            assert vals == sorted(vals, reverse=True)
+
+
+def test_fused_sampling_validation(params):
+    with pytest.raises(ValueError, match="logprobs_k"):
+        ServingEngine(params, CFG, logprobs_k=-1)
+    with pytest.raises(ValueError, match="fused_sampling"):
+        ServingEngine(params, CFG, fused_sampling=False, logprobs_k=2)
+    draft = transformer.init_params(DRAFT_CFG, jax.random.key(99))
+    with pytest.raises(ValueError, match="fused decode path"):
+        ServingEngine(
+            params, CFG, fused_sampling=False, spec_k=2,
+            draft_params=draft, draft_cfg=DRAFT_CFG,
+        )
+    with pytest.raises(ValueError, match="fused decode path"):
+        ServingEngine(
+            params, CFG, logprobs_k=1, spec_k=2,
+            draft_params=draft, draft_cfg=DRAFT_CFG,
+        )
+
+
+def test_unfused_sampled_matches_fused_sampled_stream(params):
+    """Beyond greedy: at temperature > 0 the two lanes share the key
+    stream (sample_tokens is jit-boundary invariant), so sampled tokens
+    are bit-identical too."""
+    prompts = _prompts(3)
+    kw = dict(steps_per_sched=3)
+    outs = []
+    for fused in (True, False):
+        eng = ServingEngine(
+            params, CFG, temperature=0.7, top_k=8, max_batch=2,
+            n_blocks=24, block_size=8, fused_sampling=fused, seed=5, **kw,
+        )
+        for p in prompts:
+            eng.submit(p, 6)
+        outs.append(eng.run(pipeline=False))
+    assert outs[0] == outs[1]

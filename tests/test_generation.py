@@ -615,3 +615,66 @@ def test_cast_params_for_inference_bit_identical(variant):
         g1 = generate(p, cfg, x, 8, jax.random.key(2), temperature=0.0)
         g2 = generate(pc, cfg, x, 8, jax.random.key(2), temperature=0.0)
         assert bool(jnp.all(g1 == g2))
+
+
+def _sample_logits_fullsort_reference(
+    logits, key, *, temperature=1.0, top_k=None, top_p=None, min_p=None
+):
+    """The pre-top_k-rework sampler (full jnp.sort for the k-th threshold
+    and a second sort for top-p), inlined as the distribution-identity
+    reference: filters are value-threshold masks, so the lax.top_k
+    rework must pick the SAME token for the same key, ties included."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    bad = jnp.any(jnp.isnan(logits) | (logits == jnp.inf), axis=-1)
+    logits = logits / temperature
+    if min_p is not None and 0.0 < min_p <= 1.0:
+        cutoff = jnp.max(logits, axis=-1, keepdims=True) + jnp.log(min_p)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff_logit = jnp.take_along_axis(
+            sorted_desc, cutoff_idx[:, None], axis=-1
+        )
+        logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+    sampled = jax.random.categorical(key, logits, axis=-1)
+    return jnp.where(bad, jnp.int32(-1), sampled.astype(jnp.int32))
+
+
+@pytest.mark.parametrize("knobs", [
+    dict(top_k=4),
+    dict(top_k=1),
+    dict(top_p=0.7),
+    dict(top_k=4, top_p=0.7),
+    dict(top_k=3, top_p=0.95, min_p=0.01),
+    dict(top_k=50),  # k >= V: no-op filter
+])
+def test_sample_logits_topk_rework_distribution_identity(knobs):
+    """The lax.top_k sampler must be token-for-token identical to the
+    old full-sort implementation — same masked distribution, same
+    categorical draw per key — including logits with exact ties AT the
+    k-th value and at the nucleus cutoff."""
+    rng = np.random.default_rng(42)
+    for trial in range(6):
+        logits = rng.normal(size=(5, 16)).astype(np.float32) * 3.0
+        if trial % 2:
+            # Inject ties straddling the thresholds: rows where several
+            # entries share the k-th-largest value exactly.
+            logits[0, :6] = 1.25
+            logits[1, 3:9] = logits[1, 3]
+            logits[2] = 0.0
+        jl = jnp.asarray(logits)
+        for seed in range(3):
+            key = jax.random.key(trial * 10 + seed)
+            got = sample_logits(jl, key, temperature=0.8, **knobs)
+            want = _sample_logits_fullsort_reference(
+                jl, key, temperature=0.8, **knobs
+            )
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -270,3 +270,141 @@ def test_ragged_validation():
             q, kp, jnp.zeros((8, 8, 2, 32)), jnp.zeros((2, 2), jnp.int32),
             jnp.zeros((2,), jnp.int32), ql,
         )
+
+
+# ---------------------------------------------------------------------------
+# FA2 KV-split partitioning + AMLA add-based rescaling (the speed push).
+# `ragged_gather_attention` stays the single source of truth: every variant
+# below must reproduce it on the same fragmented state.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("amla", [False, True])
+@pytest.mark.parametrize("kv_splits", [2, 3, 4, 0])  # 0 -> auto
+@pytest.mark.parametrize("g,window", [(2, 0), (4, 12)])
+def test_ragged_kv_split_amla_matches_gather(g, window, kv_splits, amla):
+    """KV-split grid (every partition count incl. auto) x AMLA rescaling
+    over the ragged identity grid. The split path computes per-partition
+    unnormalized partials combined in XLA; AMLA replaces the MUL
+    rescaling with exponent adds — both must land on the gather answer
+    to accumulation-order tolerance."""
+    rng = np.random.default_rng(7000 + g * 100 + window + kv_splits * 7 + amla)
+    b, t, h, d, bs, n_blocks, max_blocks = 3, 6, 8, 64, 8, 24, 5
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, g, d)), jnp.float32)
+    tables, seq, qlens = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    args = (jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens))
+    out = ragged_paged_attention(
+        q, kp, vp, *args, window=window,
+        kv_splits=kv_splits or None, amla=amla,
+    )
+    ref = ragged_gather_attention(q, kp, vp, *args, window=window)
+    # AMLA's exp2 pipeline reorders the same flops; 2e-4 is ~500x the
+    # measured worst case (3.6e-7) yet far below any masking error.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4 if amla else 2e-5
+    )
+
+
+@pytest.mark.parametrize("qlen_kind", ["decode", "chunk"])
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("window", [0, 12])
+@pytest.mark.parametrize("seq_edge", [15, 16, 17])
+def test_ragged_kv_split_partition_boundary(seq_edge, window, int8, qlen_kind):
+    """seq_lens exactly on / one-below / one-above a KV-split edge.
+
+    With nb=4 pages of bs=8 and kv_splits=2, partition 0 owns pages
+    {0,1} (slots 0..15) and partition 1 owns pages {2,3}: slot 16 is the
+    first slot of partition 1, so seq 15/16/17 put the last live token
+    one-below / exactly-on / one-above the edge. The second partition is
+    empty, one-token, or two-token — the l==0 guard and the cross-
+    partition log-sum-exp combine must all hold, for decode (q_len 1)
+    and chunk (q_len t) rows, windowed and int8 included."""
+    rng = np.random.default_rng(9000 + seq_edge * 8 + window + int8 * 2)
+    b, t, h, g, d, bs, n_blocks, max_blocks = 2, 4, 8, 2, 64, 8, 16, 4
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    kf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    vf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    tables = np.asarray([[3, 5, 7, 9], [2, 4, 6, 8]], np.int32)
+    qlens = np.asarray([1, 1] if qlen_kind == "decode" else [t, t], np.int32)
+    # seq is the committed length; the last live slot is seq + q_len - 1.
+    seq = np.asarray([seq_edge - int(qlens[0]) + 1] * b, np.int32)
+    scales = {}
+    if int8:
+        kq, ks = _quantize_pool(kf)
+        vq, vs = _quantize_pool(vf)
+        kp, vp, scales = kq, vq, {"k_scale": ks, "v_scale": vs}
+    else:
+        kp, vp = jnp.asarray(kf), jnp.asarray(vf)
+    args = (jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens))
+    ref = ragged_gather_attention(q, kp, vp, *args, window=window, **scales)
+    for amla in (False, True):
+        out = ragged_paged_attention(
+            q, kp, vp, *args, window=window, kv_splits=2, amla=amla,
+            **scales,
+        )
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-4 if amla else 2e-5,
+            err_msg=f"amla={amla}",
+        )
+
+
+def test_ragged_kv_split_int8_mixed_rows():
+    """The full production mix under the split kernel: quantized pools,
+    ragged decode+chunk rows, fragmented tables, splits x amla."""
+    rng = np.random.default_rng(31)
+    b, t, h, g, d, bs, n_blocks, max_blocks = 4, 8, 8, 4, 64, 8, 32, 6
+    q, qlens = _mixed_batch(rng, b, t, h, d)
+    kf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    vf = rng.normal(size=(n_blocks, bs, g, d)).astype(np.float32)
+    kq, ks = _quantize_pool(kf)
+    vq, vs = _quantize_pool(vf)
+    tables, seq, _ = _random_state(rng, b, n_blocks, max_blocks, bs, t)
+    seq = np.minimum(seq, max_blocks * bs - t)
+    args = (jnp.asarray(tables), jnp.asarray(seq), jnp.asarray(qlens))
+    ref = ragged_gather_attention(q, kq, vq, *args, k_scale=ks, v_scale=vs)
+    for kv_splits, amla in [(2, False), (3, True), (None, True)]:
+        out = ragged_paged_attention(
+            q, kq, vq, *args, k_scale=ks, v_scale=vs,
+            kv_splits=kv_splits, amla=amla,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref),
+            atol=2e-4 if amla else 2e-5,
+            err_msg=f"kv_splits={kv_splits} amla={amla}",
+        )
+
+
+def test_auto_kv_splits_heuristic():
+    """The partition-count heuristic: more splits when the batch is too
+    small to fill the grid, none when the batch already does; never
+    leaves a partition with fewer than 2 pages; always >= 1."""
+    from pretraining_llm_tpu.ops.pallas_ragged import _auto_kv_splits
+
+    assert _auto_kv_splits(32, 1) == 8
+    assert _auto_kv_splits(8, 1) == 4
+    assert _auto_kv_splits(8, 2) == 4
+    assert _auto_kv_splits(4, 2) == 2
+    assert _auto_kv_splits(2, 1) == 1   # split would leave <2 pages each
+    assert _auto_kv_splits(1, 1) == 1
+    for nb in range(1, 40):
+        for b in range(1, 12):
+            p = _auto_kv_splits(nb, b)
+            assert p >= 1
+            assert p == 1 or nb // p >= 2
+    assert _auto_kv_splits(64, 8) == 1  # batch fills the grid already
+
+
+def test_ragged_kv_splits_validation():
+    q = jnp.zeros((2, 3, 4, 64))
+    kp = jnp.zeros((8, 8, 2, 64))
+    tbl = jnp.zeros((2, 2), jnp.int32)
+    seq = jnp.zeros((2,), jnp.int32)
+    ql = jnp.ones((2,), jnp.int32)
+    with pytest.raises(ValueError, match="kv_splits"):
+        ragged_paged_attention(q, kp, kp, tbl, seq, ql, kv_splits=-1)
+    # More splits than pages clamps rather than launching empty programs.
+    out = ragged_paged_attention(q, kp, kp, tbl, seq, ql, kv_splits=64)
+    assert out.shape == q.shape
